@@ -1,0 +1,26 @@
+"""Force an 8-device CPU mesh for the test suite.
+
+This is the TPU-world analogue of torch's gloo-on-CPU "fake backend" pattern
+(SURVEY §4): XLA's host-platform device-count flag emulates a multi-chip
+slice in one process, so every distributed code path (pmean grads, SyncBN,
+sharded eval) is exercised without TPU hardware.
+
+NOTE on mechanism: the platform switch is done via ``jax.config`` AFTER
+importing jax, not by exporting ``JAX_PLATFORMS=cpu`` into the process
+environment — some TPU runtime environments install a sitecustomize that
+registers the TPU PJRT plugin at interpreter start and misbehaves when the
+env var contradicts it. ``jax.config.update`` after import, before the first
+backend use, is always safe.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,20 @@
+"""tpu_dist — a TPU-native distributed training framework.
+
+A brand-new JAX/XLA framework with the capabilities of the PyTorch DDP
+tutorial repo ``rentainhe/pytorch-distributed-training`` (see SURVEY.md):
+data-parallel training over a device mesh, gradient accumulation with
+``no_sync`` semantics, bf16 mixed precision (replacing apex AMP),
+cross-replica synchronized BatchNorm, sharded data loading with
+epoch-seeded shuffling, cross-replica metric reduction, rank-0 logging,
+distributed evaluation and checkpoint/resume.
+
+On TPU the reference's DP and DDP engines collapse into one model: a single
+process per host drives all local chips; parameters live replicated on a
+``jax.sharding.Mesh`` and gradients are ``pmean``-ed over the ``data`` axis
+inside one compiled step (reference: ``distributed.py:60``,
+``dataparallel.py:47``).
+"""
+
+__version__ = "0.1.0"
+
+from tpu_dist.comm import mesh as mesh  # noqa: F401

@@ -1,0 +1,1 @@
+from tpu_dist.ckpt.checkpoint import latest_checkpoint, restore, save  # noqa: F401

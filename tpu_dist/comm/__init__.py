@@ -1,0 +1,16 @@
+from tpu_dist.comm.mesh import (  # noqa: F401
+    data_parallel_mesh,
+    device_mesh,
+    initialize_distributed,
+    local_device_count,
+    process_count,
+    process_index,
+)
+from tpu_dist.comm.collectives import (  # noqa: F401
+    all_gather,
+    barrier,
+    broadcast_from,
+    host_allreduce_mean,
+    reduce_mean,
+    reduce_sum,
+)

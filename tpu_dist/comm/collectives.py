@@ -1,0 +1,103 @@
+"""Collective primitives over the mesh — the NCCL-replacement layer (N1).
+
+The reference reaches native collectives at four call sites (SURVEY §2.2):
+``all_reduce(SUM)`` inside ``reduce_mean`` (``utils/util.py:5-9``),
+``barrier()`` (``distributed.py:95``, ``utils/validation.py:30``), DDP's
+bucketed gradient allreduce (``distributed.py:60``) and SyncBN's statistics
+allreduce (``distributed.py:59``). On TPU all four become XLA collectives
+(``lax.pmean``/``lax.psum``/``lax.all_gather``) that lower onto ICI within a
+slice and DCN across slices; inside one compiled step they are ordered by
+XLA's dataflow, so the reference's defensive per-step ``barrier()`` has no
+equivalent cost here.
+
+Functions named ``*_mean``/``*_sum``/``all_gather`` are *traced* collectives:
+call them inside a ``shard_map``-ed function with the mesh axis in scope.
+``host_*`` helpers are eager, for host-side coordination between compiled
+steps (multi-host bootstrap checks, checkpoint gating).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from tpu_dist.comm import mesh as mesh_lib
+
+
+def reduce_mean(x, axis_name: str = mesh_lib.DATA_AXIS):
+    """Cross-replica mean — drop-in for the reference's ``reduce_mean``
+    (``utils/util.py:5-9``: clone → all_reduce(SUM) → /nprocs), fused into
+    the surrounding computation by XLA instead of a separate NCCL launch."""
+    return lax.pmean(x, axis_name)
+
+
+def reduce_sum(x, axis_name: str = mesh_lib.DATA_AXIS):
+    """Cross-replica sum (``dist.all_reduce(op=SUM)``)."""
+    return lax.psum(x, axis_name)
+
+
+def all_gather(x, axis_name: str = mesh_lib.DATA_AXIS, axis: int = 0, tiled: bool = True):
+    """Gather shards from every replica (``dist.all_gather``)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def broadcast_from(x, axis_name: str = mesh_lib.DATA_AXIS, src: int = 0):
+    """Broadcast ``src``'s value to every replica — the DDP init-time
+    parameter broadcast (``distributed.py:60`` wrap semantics)."""
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def barrier(mesh: Optional[Mesh] = None) -> None:
+    """Host-level fence across the whole mesh.
+
+    The reference calls ``dist.barrier()`` before every metric reduction
+    (``distributed.py:95``, ``utils/validation.py:30``); under XLA that
+    ordering is implied by dataflow, so this exists only for host-side
+    coordination (e.g. "everyone finished the epoch before rank 0 writes a
+    checkpoint"). Implemented as a tiny device psum that every process must
+    join, then a blocking readback.
+    """
+    m = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+    jax.block_until_ready(_fence_for(m)(jnp.zeros((), jnp.int32)))
+
+
+@functools.lru_cache(maxsize=None)
+def _fence_for(m: Mesh):
+    return jax.jit(
+        shard_map(
+            lambda x: lax.psum(x + 1, mesh_lib.DATA_AXIS),
+            mesh=m,
+            in_specs=P(),
+            out_specs=P(),
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _pmean_for(m: Mesh):
+    return jax.jit(
+        shard_map(
+            lambda v: lax.pmean(v, mesh_lib.DATA_AXIS),
+            mesh=m,
+            in_specs=P(),
+            out_specs=P(),
+        )
+    )
+
+
+def host_allreduce_mean(x, mesh: Optional[Mesh] = None):
+    """Eager cross-replica mean of a host value (returns numpy scalar/array).
+
+    For occasional host-side aggregation outside the compiled step — e.g.
+    averaging epoch wall-times. Not for the hot loop.
+    """
+    m = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+    return jax.device_get(_pmean_for(m)(jnp.asarray(x)))

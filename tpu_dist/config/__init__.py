@@ -1,0 +1,1 @@
+from tpu_dist.config.config import TrainConfig, add_reference_flags, config_from_args  # noqa: F401

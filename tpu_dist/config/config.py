@@ -1,0 +1,105 @@
+"""One shared config layer: dataclass + argparse bridge.
+
+Replaces both the per-script argparse blocks the reference duplicates six
+times (``distributed.py:18-25``, ``dataparallel.py:18-23``,
+``distributed_gradient_accumulation.py:26``) and the dead ``global_config``
+(``utils/config.py:1-10``, never imported). Every reference flag is
+preserved; ``--ip/--port`` become the multi-host coordinator address
+(rendezvous is slice discovery on TPU, SURVEY §3.5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+
+@dataclass
+class TrainConfig:
+    # -- reference flags (distributed.py:18-25) -----------------------------
+    batch_size: int = 256          # GLOBAL batch; per-replica = batch_size / n_devices
+    epochs: int = 200
+    lr: float = 0.1
+    seed: Optional[int] = None     # per-rank seeding when set (distributed_mp.py:29-39)
+    ip: str = "127.0.0.1"          # coordinator host (was hard-coded 10.24.82.29)
+    port: int = 23456              # coordinator port
+    grad_accu_steps: int = 1       # distributed_gradient_accumulation.py:26
+
+    # -- optimizer / schedule (hard-coded in the reference) -----------------
+    momentum: float = 0.9          # distributed.py:63
+    weight_decay: float = 1e-4     # distributed.py:63
+    lr_milestones: Tuple[int, ...] = (60, 120, 160)  # distributed.py:64
+    lr_gamma: float = 0.2          # distributed.py:64
+
+    # -- TPU-native switches (replace whole reference scripts) --------------
+    bf16: bool = False             # apex AMP path (distributed_apex.py) → bf16 policy
+    sync_bn: bool = True           # SyncBN on by default (README.md:62)
+    drop_last: bool = False        # grad-accum path uses True (…accumulation.py:71)
+
+    # -- data ---------------------------------------------------------------
+    dataset: str = "cifar100"      # cifar100 | synthetic
+    data_dir: str = "./data"
+    num_workers: int = 4           # loader prefetch depth (passed to DataLoader)
+
+    # -- model --------------------------------------------------------------
+    model: str = "resnet18"        # resnet18 | resnet34 | resnet50 | vit_b16
+    num_classes: int = 100
+
+    # -- multi-host ---------------------------------------------------------
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+
+    # -- checkpoint / eval cadence -----------------------------------------
+    ckpt_dir: Optional[str] = None
+    save_every: int = 15           # dead utils/config.py:7 'save_epoch', made real
+    resume: bool = False
+    eval_every: int = 1
+    log_every: int = 20
+
+    # -- bench / smoke ------------------------------------------------------
+    steps_per_epoch: Optional[int] = None  # cap steps (smoke tests / benches)
+
+    @property
+    def coordinator_address(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def replace(self, **kw) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    d = TrainConfig()
+    p.add_argument("--batch_size", "--batch-size", type=int, default=d.batch_size)
+    p.add_argument("--epochs", type=int, default=d.epochs)
+    p.add_argument("--lr", type=float, default=d.lr)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--ip", type=str, default=d.ip)
+    p.add_argument("--port", type=int, default=d.port)
+    p.add_argument("--grad_accu_steps", type=int, default=d.grad_accu_steps)
+    p.add_argument("--momentum", type=float, default=d.momentum)
+    p.add_argument("--weight_decay", type=float, default=d.weight_decay)
+    p.add_argument("--bf16", action="store_true")
+    p.add_argument("--no_sync_bn", dest="sync_bn", action="store_false")
+    p.add_argument("--dataset", type=str, default=d.dataset)
+    p.add_argument("--data_dir", type=str, default=d.data_dir)
+    p.add_argument("--model", type=str, default=d.model)
+    p.add_argument("--num_classes", type=int, default=d.num_classes)
+    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--process_id", type=int, default=None)
+    p.add_argument("--ckpt_dir", type=str, default=None)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--steps_per_epoch", type=int, default=None)
+    p.add_argument("--log_every", type=int, default=d.log_every)
+    # accepted for command-line parity with torch.distributed.launch; unused
+    p.add_argument("--local_rank", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--gpu", type=str, default=None, help=argparse.SUPPRESS)
+    return p
+
+
+def config_from_args(args: argparse.Namespace, **overrides) -> TrainConfig:
+    fields = {f.name for f in dataclasses.fields(TrainConfig)}
+    kw = {k: v for k, v in vars(args).items() if k in fields}
+    kw.update(overrides)
+    return TrainConfig(**kw)

@@ -1,0 +1,49 @@
+"""Self-contained CIFAR-100 reader (torchvision replacement, SURVEY §2.2 N7).
+
+Reads the standard ``cifar-100-python`` pickle layout that the reference's
+``datasets.CIFAR100(root='./data', download=True)`` produces
+(``utils/dataset.py:10-13``). This build runs with zero network egress, so
+there is no downloader: the loader looks for an existing extraction (or
+``.tar.gz``) under ``data_dir`` and raises a clear error otherwise; tests
+and benches fall back to :func:`tpu_dist.data.synthetic.synthetic_cifar`.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+from typing import Tuple
+
+import numpy as np
+
+_ARCHIVE = "cifar-100-python.tar.gz"
+_DIRNAME = "cifar-100-python"
+
+
+def _find_root(data_dir: str) -> str:
+    d = os.path.join(data_dir, _DIRNAME)
+    if os.path.isdir(d):
+        return d
+    tar = os.path.join(data_dir, _ARCHIVE)
+    if os.path.isfile(tar):
+        with tarfile.open(tar, "r:gz") as tf:
+            tf.extractall(data_dir)
+        return d
+    raise FileNotFoundError(
+        f"CIFAR-100 not found under {data_dir!r} (need {_DIRNAME}/ or {_ARCHIVE}); "
+        "this environment has no network egress — place the archive there, or use "
+        "dataset='synthetic'."
+    )
+
+
+def load_cifar100(data_dir: str = "./data", train: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns ``(images_u8 [N,32,32,3], labels_i32 [N])`` — fine labels,
+    matching the reference's ``datasets.CIFAR100`` splits."""
+    root = _find_root(data_dir)
+    fname = "train" if train else "test"
+    with open(os.path.join(root, fname), "rb") as f:
+        d = pickle.load(f, encoding="latin1")
+    data = np.asarray(d["data"], np.uint8).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    labels = np.asarray(d["fine_labels"], np.int32)
+    return np.ascontiguousarray(data), labels

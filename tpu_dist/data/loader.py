@@ -1,0 +1,142 @@
+"""Batched, prefetching device feeder — the ``DataLoader``/``ParallelLoader``
+role (reference ``distributed.py:71,75``: ``DataLoader(..., num_workers=4,
+pin_memory=True, sampler=...)``; torch-xla's ``ParallelLoader`` in the
+BASELINE north star).
+
+Differences from torch, by design:
+
+* Datasets at this framework's scope are in-memory numpy arrays, so there
+  are no worker *processes*; a single background thread pipelines host-side
+  augmentation + H2D placement one batch ahead of the device (the role of
+  ``pin_memory`` + workers). When the optional C++ pipeline extension is
+  built (``tpu_dist/csrc``), augmentation runs there in native threads.
+* The loader emits **globally sharded** ``jax.Array`` batches: one process
+  feeds all its local chips (SURVEY §7 design stance), the leading batch
+  dim is laid over the mesh's ``data`` axis.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, Optional, Tuple
+
+import numpy as np
+from jax.sharding import Mesh
+
+from tpu_dist.comm import mesh as mesh_lib
+from tpu_dist.data.sampler import DistributedSampler
+
+
+class DataLoader:
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        batch_size: int,
+        sampler: DistributedSampler,
+        mesh: Mesh,
+        transform: Optional[Callable[[np.ndarray, np.random.Generator], np.ndarray]] = None,
+        eval_transform: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+        seed: int = 0,
+        prefetch: int = 2,
+        with_mask: bool = False,
+    ):
+        """``batch_size`` is the PER-PROCESS batch (the reference's manual
+        ``global_batch / nprocs`` split, ``distributed.py:67``, happens in
+        the trainer). ``with_mask`` adds the sampler's pad mask to each batch
+        for exact distributed eval."""
+        n_local = mesh_lib.local_device_count()
+        if batch_size % n_local:
+            raise ValueError(
+                f"per-process batch {batch_size} must divide over {n_local} local devices"
+            )
+        self.images = images
+        self.labels = labels
+        self.batch_size = batch_size
+        self.sampler = sampler
+        self.mesh = mesh
+        self.transform = transform
+        self.eval_transform = eval_transform
+        self.seed = seed
+        self.prefetch = max(1, prefetch)
+        self.with_mask = with_mask
+
+    def __len__(self) -> int:
+        return len(self.sampler) // self.batch_size if self.sampler.drop_last else -(
+            -len(self.sampler) // self.batch_size
+        )
+
+    def _host_batches(self) -> Iterator[Tuple[np.ndarray, ...]]:
+        idx = self.sampler.indices()
+        mask = self.sampler.pad_mask() if self.with_mask else None
+        # epoch- and rank-aware augmentation stream (init_seeds parity,
+        # reference distributed_mp.py:29-39,56)
+        rng = np.random.default_rng(
+            (self.seed, self.sampler.epoch, self.sampler.shard_id)
+        )
+        n = len(idx)
+        nb = len(self)
+        for b in range(nb):
+            sel = idx[b * self.batch_size : (b + 1) * self.batch_size]
+            pad = self.batch_size - len(sel)
+            bmask = mask[b * self.batch_size : b * self.batch_size + len(sel)] if self.with_mask else None
+            if pad:  # last partial batch: pad to static shape, mask the tail
+                sel = np.concatenate([sel, np.repeat(sel[-1:], pad)])
+                if bmask is not None:
+                    bmask = np.concatenate([bmask, np.zeros(pad, bool)])
+            imgs = self.images[sel]
+            if self.transform is not None:
+                imgs = self.transform(imgs, rng)
+            elif self.eval_transform is not None:
+                imgs = self.eval_transform(imgs)
+            out = (imgs, self.labels[sel])
+            if self.with_mask:
+                out = out + (bmask.astype(np.float32),)
+            yield out
+
+    def __iter__(self):
+        """Yields device-sharded batches, pipelined one step ahead."""
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        err = []
+        stop = threading.Event()
+
+        def producer():
+            try:
+                for hb in self._host_batches():
+                    batch = mesh_lib.shard_batch(self.mesh, hb)
+                    # bounded put that notices consumer abandonment (e.g. the
+                    # trainer's steps_per_epoch early break) instead of
+                    # blocking forever and leaking the thread + device batches
+                    while not stop.is_set():
+                        try:
+                            q.put(batch, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except Exception as e:  # surfaced on the consumer side
+                err.append(e)
+            finally:
+                if not stop.is_set():
+                    q.put(None)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+                yield item
+        finally:
+            stop.set()
+            while t.is_alive():  # drain so no producer put can block forever
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                t.join(timeout=0.05)
+            if err:
+                raise err[0]

@@ -1,0 +1,79 @@
+"""Distributed sampler with exact reference semantics, torch-free.
+
+Re-implements the contract of ``torch.utils.data.distributed.DistributedSampler``
+as the reference uses it (``distributed.py:70,74,81``):
+
+* same epoch-seeded global permutation on every shard (``set_epoch``, whose
+  shuffle-correctness role is explained in reference ``tutorials/2:§2``),
+* pad-to-even division across shards (and, new here, the pad indices are
+  *reported* so evaluation can mask them instead of double-counting —
+  the reference's eval bug documented in SURVEY §3.4),
+* optional ``drop_last`` (the grad-accum trainer's loader,
+  ``distributed_gradient_accumulation.py:71``).
+
+On TPU one process drives many chips, so "shard" here means *host process*;
+the per-host batch is split further across local devices by the sharding of
+the batch array, not by the sampler.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class DistributedSampler:
+    def __init__(
+        self,
+        num_examples: int,
+        num_shards: int = 1,
+        shard_id: int = 0,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if not (0 <= shard_id < num_shards):
+            raise ValueError(f"shard_id {shard_id} out of range for {num_shards} shards")
+        self.num_examples = num_examples
+        self.num_shards = num_shards
+        self.shard_id = shard_id
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+
+        if drop_last:
+            self.num_samples = num_examples // num_shards
+        else:
+            self.num_samples = -(-num_examples // num_shards)  # ceil
+        self.total_size = self.num_samples * num_shards
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reference ``train_sampler.set_epoch(epoch)`` (``distributed.py:81``)."""
+        self.epoch = epoch
+
+    def indices(self) -> np.ndarray:
+        """This shard's indices for the current epoch (deterministic)."""
+        if self.shuffle:
+            g = np.random.default_rng(self.seed + self.epoch)
+            order = g.permutation(self.num_examples)
+        else:
+            order = np.arange(self.num_examples)
+        if self.drop_last:
+            order = order[: self.total_size]
+        elif len(order) < self.total_size:
+            # wrap-around padding, same policy as torch's sampler
+            order = np.concatenate([order, order[: self.total_size - len(order)]])
+        return order[self.shard_id :: self.num_shards]
+
+    def pad_mask(self) -> np.ndarray:
+        """True for real examples, False for wrap-around padding — lets eval
+        count each example exactly once (deliberate fix of SURVEY §3.4)."""
+        if self.drop_last:
+            return np.ones(self.num_samples, dtype=bool)
+        # Padding occupies the tail of the padded global order regardless of
+        # shuffle (the permutation covers only the first num_examples slots).
+        positions = np.arange(self.shard_id, self.total_size, self.num_shards)
+        return positions < self.num_examples
+
+    def __len__(self) -> int:
+        return self.num_samples

@@ -1,0 +1,24 @@
+"""Synthetic CIFAR-shaped data for tests and throughput benches.
+
+The BASELINE metric is seconds/epoch and images/sec/chip (SURVEY §6) — a
+throughput measurement that random pixels exercise identically to real ones.
+Deterministic per seed.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def synthetic_cifar(
+    n: int = 50_000,
+    num_classes: int = 100,
+    image_size: int = 32,
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    images = rng.integers(0, 256, size=(n, image_size, image_size, 3), dtype=np.uint8)
+    labels = rng.integers(0, num_classes, size=(n,), dtype=np.int32)
+    return images, labels

@@ -1,0 +1,48 @@
+"""NumPy image transforms (torchvision replacement, SURVEY §2.2 N7).
+
+Mirrors the reference pipeline exactly (``utils/dataset.py:5-21``):
+train = RandomCrop(32, padding=4) + normalize; test = normalize only; same
+hard-coded CIFAR-100 per-channel mean/std. Operates on NHWC uint8 batches
+and is fully vectorized — per-batch host cost is a copy + gather, the rest
+(normalize) is folded into the device step where XLA fuses it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# utils/dataset.py:8,20
+CIFAR100_MEAN = np.array([0.5070751592371323, 0.48654887331495095, 0.4409178433670343], np.float32)
+CIFAR100_STD = np.array([0.2673342858792401, 0.2564384629170883, 0.27615047132568404], np.float32)
+
+
+def normalize(x: np.ndarray) -> np.ndarray:
+    """uint8 NHWC → float32 normalized (ToTensor + Normalize)."""
+    return (x.astype(np.float32) / 255.0 - CIFAR100_MEAN) / CIFAR100_STD
+
+
+def random_crop_batch(x: np.ndarray, rng: np.random.Generator, padding: int = 4) -> np.ndarray:
+    """Vectorized RandomCrop(H, padding=4) over a NHWC batch.
+
+    Pads with zeros (torch default) and gathers one HxW window per image via
+    strided view indexing — no Python loop over the batch.
+    """
+    n, h, w, c = x.shape
+    xp = np.pad(x, ((0, 0), (padding, padding), (padding, padding), (0, 0)))
+    ys = rng.integers(0, 2 * padding + 1, size=n)
+    xs = rng.integers(0, 2 * padding + 1, size=n)
+    # windowed view: [N, 2p+1, 2p+1, H, W, C] is too big; gather row/col idx
+    rows = ys[:, None] + np.arange(h)[None, :]          # [N, H]
+    cols = xs[:, None] + np.arange(w)[None, :]          # [N, W]
+    out = xp[np.arange(n)[:, None, None], rows[:, :, None], cols[:, None, :], :]
+    return out
+
+
+def train_augment(x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+    """Reference train transform (crop only — the reference uses no flip,
+    ``utils/dataset.py:5-9``), returning float32 normalized NHWC."""
+    return normalize(random_crop_batch(x, rng))
+
+
+def eval_transform(x: np.ndarray) -> np.ndarray:
+    return normalize(x)

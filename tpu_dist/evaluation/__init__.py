@@ -1,0 +1,1 @@
+from tpu_dist.evaluation.validate import validate  # noqa: F401

@@ -1,0 +1,2 @@
+from tpu_dist.metrics.meters import AverageMeter, ProgressMeter  # noqa: F401
+from tpu_dist.metrics.logging import get_logger, rank0_print  # noqa: F401

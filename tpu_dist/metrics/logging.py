@@ -1,0 +1,29 @@
+"""Rank-0 logging discipline (reference ``tutorials/2:§3``; guard pattern at
+``distributed.py:103,114``): only the primary process prints/logs."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+import jax
+
+
+def rank0_print(*args, **kwargs) -> None:
+    if jax.process_index() == 0:
+        print(*args, **kwargs, flush=True)
+
+
+def get_logger(name: str = "tpu_dist") -> logging.Logger:
+    """Logger that is a no-op on non-primary processes."""
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        if jax.process_index() == 0:
+            h = logging.StreamHandler(sys.stdout)
+            h.setFormatter(logging.Formatter("%(asctime)s %(levelname)s %(message)s"))
+            logger.addHandler(h)
+            logger.setLevel(logging.INFO)
+        else:
+            logger.addHandler(logging.NullHandler())
+            logger.setLevel(logging.CRITICAL)
+    return logger

@@ -1,0 +1,58 @@
+"""Running-average meters and progress display.
+
+API-parity with the reference's metrics kit (``utils/util.py:11-48``):
+``AverageMeter(name, fmt)`` keeps val/avg/sum/count with the same ``__str__``
+format; ``ProgressMeter(num_batches, meters, prefix)`` prints the same
+``[ 12/196] loss 1.23 (1.50)`` lines. The cross-replica part of the
+reference kit (``reduce_mean``, ``utils/util.py:5-9``) lives in
+``tpu_dist.comm.collectives`` and — in the hot path — inside the compiled
+step, so meters here only ever see already-reduced host scalars.
+"""
+
+from __future__ import annotations
+
+
+class AverageMeter:
+    """Computes and stores the average and current value."""
+
+    def __init__(self, name: str, fmt: str = ":f"):
+        self.name = name
+        self.fmt = fmt
+        self.reset()
+
+    def reset(self) -> None:
+        self.val = 0.0
+        self.avg = 0.0
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, val: float, n: int = 1) -> None:
+        val = float(val)
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / max(self.count, 1)
+
+    def __str__(self) -> str:
+        fmtstr = "{name} {val" + self.fmt + "} ({avg" + self.fmt + "})"
+        return fmtstr.format(**self.__dict__)
+
+
+class ProgressMeter:
+    def __init__(self, num_batches: int, *meters: AverageMeter, prefix: str = ""):
+        self.batch_fmtstr = self._get_batch_fmtstr(num_batches)
+        self.meters = meters
+        self.prefix = prefix
+
+    def display(self, batch: int) -> str:
+        entries = [self.prefix + self.batch_fmtstr.format(batch)]
+        entries += [str(m) for m in self.meters]
+        line = "\t".join(entries)
+        print(line, flush=True)
+        return line
+
+    @staticmethod
+    def _get_batch_fmtstr(num_batches: int) -> str:
+        num_digits = len(str(num_batches // 1))
+        fmt = "{:" + str(num_digits) + "d}"
+        return "[" + fmt + "/" + fmt.format(num_batches) + "]"

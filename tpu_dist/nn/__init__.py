@@ -1,0 +1,8 @@
+from tpu_dist.nn import functional as functional  # noqa: F401
+from tpu_dist.nn import layers as layers  # noqa: F401
+from tpu_dist.nn.resnet import (  # noqa: F401
+    ResNetDef,
+    resnet18,
+    resnet34,
+    resnet50,
+)

@@ -1,0 +1,44 @@
+"""Loss and classification functionals (the ``nn.CrossEntropyLoss`` /
+``accuracy`` surface of the reference, ``distributed.py:62`` and
+``utils/util.py:50-64``), written to fuse cleanly under jit."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def cross_entropy(logits, labels, *, reduction: str = "mean"):
+    """Softmax cross-entropy with integer labels.
+
+    Computed in f32 regardless of the compute dtype: the log-sum-exp is the
+    numerically fragile spot under bf16.
+    """
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    if reduction == "mean":
+        return nll.mean()
+    if reduction == "sum":
+        return nll.sum()
+    return nll
+
+
+def topk_correct(logits, labels, ks: Sequence[int] = (1, 5)):
+    """Per-batch counts of top-k hits — the core of the reference's
+    ``accuracy(output, target, topk)`` (``utils/util.py:50-64``), returned as
+    counts (not percentages) so shards can be summed exactly across replicas.
+    """
+    maxk = min(max(ks), logits.shape[-1])  # clamp: num_classes may be < 5
+    _, pred = lax.top_k(logits, maxk)  # [B, maxk]
+    hits = pred == labels[:, None]
+    return tuple(jnp.sum(hits[:, : min(k, maxk)]) for k in ks)
+
+
+def accuracy(logits, labels, topk: Sequence[int] = (1,)) -> Tuple:
+    """Percentages, reference signature (``utils/util.py:50``)."""
+    counts = topk_correct(logits, labels, topk)
+    b = logits.shape[0]
+    return tuple(c.astype(jnp.float32) * (100.0 / b) for c in counts)

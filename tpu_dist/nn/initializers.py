@@ -1,0 +1,26 @@
+"""Parameter initializers.
+
+Matches the effective init of the reference model (``utils/model.py``), which
+uses torch defaults: Kaiming-uniform with ``a=sqrt(5)`` for conv/linear
+weights, uniform ``±1/sqrt(fan_in)`` for linear bias, BN scale=1 / bias=0.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def kaiming_uniform(key, shape, fan_in: int, a: float = math.sqrt(5.0), dtype=jnp.float32):
+    """torch's default ``kaiming_uniform_(a=sqrt(5))`` for conv/linear weight."""
+    gain = math.sqrt(2.0 / (1.0 + a * a))
+    bound = gain * math.sqrt(3.0 / fan_in)
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+def uniform_fan_in(key, shape, fan_in: int, dtype=jnp.float32):
+    """torch's default bias init: U(±1/sqrt(fan_in))."""
+    bound = 1.0 / math.sqrt(fan_in) if fan_in > 0 else 0.0
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)

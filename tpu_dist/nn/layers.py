@@ -1,0 +1,135 @@
+"""Functional layer primitives: conv / batchnorm / linear.
+
+Design: layers are pure ``init``/``apply`` function pairs over plain pytree
+dicts (no module objects). This keeps every model a jit-traceable function of
+``(params, state, x)`` — the shape ``pjit``/``shard_map`` want — and makes
+cross-replica SyncBatchNorm a one-argument affair (``axis_name``) instead of
+a CUDA kernel (reference: ``torch.nn.SyncBatchNorm.convert_sync_batchnorm``
+at ``distributed.py:59`` and apex's fused variant at ``distributed_apex.py:85``).
+
+Layout is NHWC (channels-last): XLA:TPU tiles the trailing dimension onto the
+MXU/VPU lanes, so channels-last keeps convs on the fast path without layout
+transposes (the reference's NCHW is a cuDNN convention, not a TPU one).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_dist.nn import initializers as init
+
+BN_MOMENTUM = 0.1  # torch BatchNorm2d default
+BN_EPS = 1e-5
+
+
+# ---------------------------------------------------------------------------
+# Conv2d (bias-free, as everywhere in the reference model: utils/model.py)
+# ---------------------------------------------------------------------------
+
+def conv_init(key, in_ch: int, out_ch: int, ksize: int, dtype=jnp.float32):
+    """HWIO kernel. fan_in = ksize*ksize*in_ch (torch convention)."""
+    fan_in = ksize * ksize * in_ch
+    w = init.kaiming_uniform(key, (ksize, ksize, in_ch, out_ch), fan_in, dtype=dtype)
+    return {"w": w}
+
+
+def conv_apply(params, x, stride: int = 1, padding: int = 0):
+    return lax.conv_general_dilated(
+        x,
+        params["w"].astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=[(padding, padding), (padding, padding)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm2d with optional cross-replica sync
+# ---------------------------------------------------------------------------
+
+def bn_init(ch: int, dtype=jnp.float32):
+    params = {"scale": jnp.ones((ch,), dtype), "bias": jnp.zeros((ch,), dtype)}
+    state = {"mean": jnp.zeros((ch,), dtype), "var": jnp.ones((ch,), dtype)}
+    return params, state
+
+
+def bn_apply(
+    params,
+    state,
+    x,
+    *,
+    train: bool,
+    axis_name: Optional[str] = None,
+    momentum: float = BN_MOMENTUM,
+    eps: float = BN_EPS,
+):
+    """Returns ``(y, new_state)``.
+
+    ``axis_name`` set → SyncBatchNorm: batch statistics are ``pmean``-ed over
+    the mesh axis, so every replica normalizes with GLOBAL-batch statistics —
+    the ~5-line TPU equivalent of the reference's native SyncBN kernels
+    (SURVEY §2.2 N5). ``axis_name=None`` → per-replica statistics, matching
+    plain ``BatchNorm2d`` under DDP without the SyncBN convert.
+
+    Running stats follow torch semantics: EMA with ``momentum`` on the
+    *unbiased* variance, normalization uses the *biased* batch variance.
+    """
+    scale = params["scale"].astype(x.dtype)
+    bias = params["bias"].astype(x.dtype)
+
+    if not train:
+        mean = state["mean"].astype(x.dtype)
+        var = state["var"].astype(x.dtype)
+        inv = lax.rsqrt(var + eps)
+        return (x - mean) * inv * scale + bias, state
+
+    reduce_axes = tuple(range(x.ndim - 1))  # all but channel
+    # Statistics in f32 even under bf16 compute: variance of bf16 sums loses
+    # too many bits at CIFAR batch sizes.
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=reduce_axes)
+    mean_sq = jnp.mean(jnp.square(xf), axis=reduce_axes)
+    n = x.size // x.shape[-1]
+    if axis_name is not None:
+        mean = lax.pmean(mean, axis_name)
+        mean_sq = lax.pmean(mean_sq, axis_name)
+        n = n * lax.psum(1, axis_name)
+    var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
+
+    unbiased = var * (n / max(n - 1, 1)) if isinstance(n, int) else var * (n / (n - 1))
+    new_state = {
+        "mean": (1.0 - momentum) * state["mean"] + momentum * mean,
+        "var": (1.0 - momentum) * state["var"] + momentum * unbiased,
+    }
+    inv = lax.rsqrt(var + eps).astype(x.dtype)
+    y = (x - mean.astype(x.dtype)) * inv * scale + bias
+    return y, new_state
+
+
+# ---------------------------------------------------------------------------
+# Linear
+# ---------------------------------------------------------------------------
+
+def linear_init(key, in_dim: int, out_dim: int, dtype=jnp.float32):
+    kw, kb = jax.random.split(key)
+    return {
+        "w": init.kaiming_uniform(kw, (in_dim, out_dim), in_dim, dtype=dtype),
+        "b": init.uniform_fan_in(kb, (out_dim,), in_dim, dtype=dtype),
+    }
+
+
+def linear_apply(params, x):
+    return x @ params["w"].astype(x.dtype) + params["b"].astype(x.dtype)
+
+
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def global_avg_pool(x):
+    """NHWC → NC (the reference's AdaptiveAvgPool2d((1,1)) + flatten)."""
+    return jnp.mean(x, axis=(1, 2))

@@ -1,0 +1,161 @@
+"""CIFAR-style ResNet-18/34/50 (TPU-native re-design of ``utils/model.py``).
+
+Architecture parity with the reference (``utils/model.py:61-127``):
+3×3 stem without maxpool (CIFAR variant, ``:66-70``), stages
+[64,128,256,512] with strides [1,2,2,2] (``:72-75``), BasicBlock
+(expansion 1, ``:3-28``) for 18/34, BottleNeck (expansion 4, ``:32-59``)
+for 50, global average pool + linear head (``:76-77``), 100 classes by
+default (``:62``). Every conv is bias-free and followed by BatchNorm — the
+property that makes SyncBN a real requirement.
+
+Differences from the reference are layout-only: NHWC tensors, functional
+``init``/``apply`` over pytree dicts (see ``tpu_dist.nn.layers``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist.nn import layers as L
+
+
+@dataclass(frozen=True)
+class ResNetDef:
+    """Static model description; ``init``/``apply`` close over it."""
+
+    block: str  # "basic" | "bottleneck"
+    stage_blocks: Tuple[int, int, int, int]
+    num_classes: int = 100
+
+    @property
+    def expansion(self) -> int:
+        return 1 if self.block == "basic" else 4
+
+    # -- init ---------------------------------------------------------------
+
+    def init(self, key, dtype=jnp.float32):
+        """Returns ``(params, bn_state)`` pytrees (nested dicts/lists)."""
+        keys = iter(jax.random.split(key, 1024))
+        params = {}
+        state = {}
+
+        params["stem_conv"] = L.conv_init(next(keys), 3, 64, 3, dtype)
+        params["stem_bn"], state["stem_bn"] = L.bn_init(64, dtype)
+
+        in_ch = 64
+        for si, (width, n_blocks, stride) in enumerate(
+            zip((64, 128, 256, 512), self.stage_blocks, (1, 2, 2, 2))
+        ):
+            blocks_p: List[dict] = []
+            blocks_s: List[dict] = []
+            for bi in range(n_blocks):
+                s = stride if bi == 0 else 1
+                p, st, in_ch = self._block_init(next(keys), in_ch, width, s, dtype)
+                blocks_p.append(p)
+                blocks_s.append(st)
+            params[f"stage{si + 1}"] = blocks_p
+            state[f"stage{si + 1}"] = blocks_s
+
+        params["fc"] = L.linear_init(next(keys), 512 * self.expansion, self.num_classes, dtype)
+        return params, state
+
+    def _block_init(self, key, in_ch, width, stride, dtype):
+        out_ch = width * self.expansion
+        ks = iter(jax.random.split(key, 8))
+        p, s = {}, {}
+        if self.block == "basic":
+            p["conv1"] = L.conv_init(next(ks), in_ch, width, 3, dtype)
+            p["bn1"], s["bn1"] = L.bn_init(width, dtype)
+            p["conv2"] = L.conv_init(next(ks), width, out_ch, 3, dtype)
+            p["bn2"], s["bn2"] = L.bn_init(out_ch, dtype)
+        else:
+            p["conv1"] = L.conv_init(next(ks), in_ch, width, 1, dtype)
+            p["bn1"], s["bn1"] = L.bn_init(width, dtype)
+            p["conv2"] = L.conv_init(next(ks), width, width, 3, dtype)
+            p["bn2"], s["bn2"] = L.bn_init(width, dtype)
+            p["conv3"] = L.conv_init(next(ks), width, out_ch, 1, dtype)
+            p["bn3"], s["bn3"] = L.bn_init(out_ch, dtype)
+        if stride != 1 or in_ch != out_ch:
+            p["sc_conv"] = L.conv_init(next(ks), in_ch, out_ch, 1, dtype)
+            p["sc_bn"], s["sc_bn"] = L.bn_init(out_ch, dtype)
+        return p, s, out_ch
+
+    # -- apply --------------------------------------------------------------
+
+    def apply(
+        self,
+        params,
+        state,
+        x,
+        *,
+        train: bool = False,
+        axis_name: Optional[str] = None,
+    ):
+        """Forward pass. ``x``: NHWC. Returns ``(logits, new_bn_state)``.
+
+        ``axis_name`` enables SyncBatchNorm over that mesh axis (reference
+        ``distributed.py:59`` semantics); only meaningful when ``train``.
+        """
+        bn = dict(train=train, axis_name=axis_name)
+        new_state = {}
+
+        y = L.conv_apply(params["stem_conv"], x, stride=1, padding=1)
+        y, new_state["stem_bn"] = L.bn_apply(params["stem_bn"], state["stem_bn"], y, **bn)
+        y = L.relu(y)
+
+        for si in range(4):
+            name = f"stage{si + 1}"
+            stage_state = []
+            for bp, bs in zip(params[name], state[name]):
+                stride = (1, 2, 2, 2)[si] if not stage_state else 1
+                y, ns = self._block_apply(bp, bs, y, stride, bn)
+                stage_state.append(ns)
+            new_state[name] = stage_state
+
+        y = L.global_avg_pool(y)
+        logits = L.linear_apply(params["fc"], y)
+        return logits, new_state
+
+    def _block_apply(self, p, s, x, stride, bn):
+        ns = {}
+        if self.block == "basic":
+            y = L.conv_apply(p["conv1"], x, stride=stride, padding=1)
+            y, ns["bn1"] = L.bn_apply(p["bn1"], s["bn1"], y, **bn)
+            y = L.relu(y)
+            y = L.conv_apply(p["conv2"], y, stride=1, padding=1)
+            y, ns["bn2"] = L.bn_apply(p["bn2"], s["bn2"], y, **bn)
+        else:
+            y = L.conv_apply(p["conv1"], x, stride=1, padding=0)
+            y, ns["bn1"] = L.bn_apply(p["bn1"], s["bn1"], y, **bn)
+            y = L.relu(y)
+            y = L.conv_apply(p["conv2"], y, stride=stride, padding=1)
+            y, ns["bn2"] = L.bn_apply(p["bn2"], s["bn2"], y, **bn)
+            y = L.relu(y)
+            y = L.conv_apply(p["conv3"], y, stride=1, padding=0)
+            y, ns["bn3"] = L.bn_apply(p["bn3"], s["bn3"], y, **bn)
+
+        if "sc_conv" in p:
+            sc = L.conv_apply(p["sc_conv"], x, stride=stride, padding=0)
+            sc, ns["sc_bn"] = L.bn_apply(p["sc_bn"], s["sc_bn"], sc, **bn)
+        else:
+            sc = x
+        return L.relu(y + sc), ns
+
+
+def resnet18(num_classes: int = 100) -> ResNetDef:
+    """Reference factory parity: ``utils/model.py:115-117``."""
+    return ResNetDef("basic", (2, 2, 2, 2), num_classes)
+
+
+def resnet34(num_classes: int = 100) -> ResNetDef:
+    """Reference factory parity: ``utils/model.py:120-122``."""
+    return ResNetDef("basic", (3, 4, 6, 3), num_classes)
+
+
+def resnet50(num_classes: int = 100) -> ResNetDef:
+    """Reference factory parity: ``utils/model.py:125-127``."""
+    return ResNetDef("bottleneck", (3, 4, 6, 3), num_classes)

@@ -1,0 +1,4 @@
+from tpu_dist.train.optim import SGD, multistep_lr  # noqa: F401
+from tpu_dist.train.state import TrainState  # noqa: F401
+from tpu_dist.train.step import make_eval_step, make_train_step  # noqa: F401
+from tpu_dist.train.trainer import Trainer  # noqa: F401

@@ -1,0 +1,31 @@
+"""TrainState — the one pytree that flows through the compiled step.
+
+Bundles what the reference scatters across mutable objects (module params,
+BN running stats buffers, ``optimizer.state``, the epoch counter) into a
+single immutable pytree, replicated over the mesh. This is what the
+checkpoint layer serializes (params + opt state + epoch — the rank-0 save
+pattern of reference ``tutorials/2:§7``, plus BN stats which torch keeps
+inside ``state_dict`` buffers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax.numpy as jnp
+
+
+class TrainState(NamedTuple):
+    params: Any      # model parameters (pytree)
+    bn_state: Any    # BatchNorm running mean/var (pytree)
+    opt_state: Any   # momentum buffers (pytree, same structure as params)
+    step: jnp.ndarray  # global step counter, int32 scalar
+
+    @classmethod
+    def create(cls, params, bn_state, optimizer) -> "TrainState":
+        return cls(
+            params=params,
+            bn_state=bn_state,
+            opt_state=optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )

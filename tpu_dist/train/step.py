@@ -1,0 +1,180 @@
+"""Compiled train/eval steps over the device mesh.
+
+This module is where the reference's four native engines collapse into one
+TPU program (SURVEY §7 design stance):
+
+* **DDP gradient allreduce** (``reducer.cpp`` behind ``distributed.py:60``)
+  → ``lax.pmean(grads, 'data')`` inside the step; XLA's latency-hiding
+  scheduler overlaps the collective with the backward, which is exactly the
+  bucketed-overlap service the DDP reducer provides.
+* **DataParallel scatter/replicate/gather** (``dataparallel.py:47``)
+  → the batch arrives sharded on the ``data`` axis, params arrive
+  replicated; nothing to scatter.
+* **apex AMP** (``distributed_apex.py:86,119-120``) → a bf16 compute policy:
+  master params stay f32, the forward/backward runs in bf16. TPUs have
+  hardware bf16 with f32 accumulation in the MXU, so there is NO loss
+  scaling — the reason apex needs it (fp16 underflow) does not exist here.
+* **grad accumulation + no_sync** (``distributed_gradient_accumulation.py:
+  90-111``) → a ``lax.scan`` over sub-batches accumulating LOCAL grads, with
+  the single ``pmean`` after the scan. Suppressing cross-rank traffic on
+  non-boundary sub-steps is precisely torch's ``model.no_sync()`` (``:106``);
+  the 1/K loss scaling (``:103,110``) appears here as the mean over chunk
+  grads.
+* **per-step barrier + reduce_mean of metrics** (``distributed.py:95,109``)
+  → the metric ``pmean`` rides the same compiled step; the barrier is
+  deleted (XLA dataflow already orders collectives — SURVEY §5).
+
+Everything is wrapped in ``jax.jit`` over a ``shard_map``, so one Python
+call runs the whole step on every chip with static shapes and no host sync.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from tpu_dist.comm import mesh as mesh_lib
+from tpu_dist.nn import functional as F
+from tpu_dist.train.state import TrainState
+
+
+def make_train_step(
+    model_apply: Callable,
+    optimizer,
+    mesh: Mesh,
+    *,
+    grad_accum_steps: int = 1,
+    sync_bn: bool = True,
+    compute_dtype=jnp.float32,
+    axis: str = mesh_lib.DATA_AXIS,
+    donate: bool = True,
+):
+    """Build ``step(state, images, labels, lr) -> (state, metrics)``.
+
+    ``model_apply(params, bn_state, x, train=, axis_name=)`` is the
+    functional model (e.g. ``ResNetDef.apply``). ``metrics`` is a dict of
+    replica-averaged scalars: loss, top-1/top-5 accuracy (the reference's
+    per-step ``reduce_mean(loss)`` + ``accuracy`` line,
+    ``distributed.py:104-111``).
+    """
+    bn_axis = axis if sync_bn else None
+    K = int(grad_accum_steps)
+
+    def loss_fn(params, bn_state, images, labels):
+        x = images.astype(compute_dtype)
+        p = jax.tree_util.tree_map(lambda t: t.astype(compute_dtype), params)
+        logits, new_bn = model_apply(p, bn_state, x, train=True, axis_name=bn_axis)
+        loss = F.cross_entropy(logits, labels)
+        return loss, (new_bn, logits)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def local_grads(params, bn_state, images, labels):
+        """Local (pre-allreduce) grads; grad-accum via scan when K > 1."""
+        if K == 1:
+            (loss, (bn, logits)), grads = grad_fn(params, bn_state, images, labels)
+            return loss, grads, bn, logits
+        # [B, ...] -> [K, B/K, ...]; BN state threads through the scan so
+        # running stats update every sub-step, like torch.
+        chunked = jax.tree_util.tree_map(
+            lambda t: t.reshape((K, t.shape[0] // K) + t.shape[1:]), (images, labels)
+        )
+
+        def body(carry, chunk):
+            bn, acc = carry
+            imgs, lbls = chunk
+            (loss, (bn, logits)), g = grad_fn(params, bn, imgs, lbls)
+            acc = jax.tree_util.tree_map(jnp.add, acc, g)
+            return (bn, acc), (loss, logits)
+
+        zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (bn, acc), (losses, logits) = lax.scan(body, (bn_state, zero), chunked)
+        grads = jax.tree_util.tree_map(lambda g: g / K, acc)  # tutorials/1 mean math
+        logits = logits.reshape((-1,) + logits.shape[2:])
+        return losses.mean(), grads, bn, logits
+
+    def step_local(state: TrainState, images, labels, lr):
+        loss, grads, new_bn, logits = local_grads(state.params, state.bn_state, images, labels)
+
+        # THE data-parallel step: average grads over the mesh (DDP engine).
+        grads = lax.pmean(grads, axis)
+        if not sync_bn:
+            # Local-BN replicas hold diverged running stats; average them so
+            # the replicated state stays consistent (torch instead keeps
+            # per-rank stats and saves rank 0's — documented deviation).
+            new_bn = lax.pmean(new_bn, axis)
+
+        new_params, new_opt = optimizer.update(grads, state.opt_state, state.params, lr)
+        new_state = TrainState(new_params, new_bn, new_opt, state.step + 1)
+
+        # Replica-averaged metrics, fused into the same program
+        labels_all = labels
+        c1, c5 = F.topk_correct(logits.astype(jnp.float32), labels_all, (1, 5))
+        b = labels_all.shape[0]
+        metrics = {
+            "loss": lax.pmean(loss, axis),
+            "acc1": lax.psum(c1, axis) / (b * lax.psum(1, axis)) * 100.0,
+            "acc5": lax.psum(c5, axis) / (b * lax.psum(1, axis)) * 100.0,
+        }
+        return new_state, metrics
+
+    sharded = shard_map(
+        step_local,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P()),
+        out_specs=(P(), P()),
+        check_rep=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(
+    model_apply: Callable,
+    mesh: Mesh,
+    *,
+    compute_dtype=jnp.float32,
+    axis: str = mesh_lib.DATA_AXIS,
+):
+    """Build ``eval_step(state, images, labels, mask) -> sums``.
+
+    Returns GLOBAL sums (loss·mask, top1, top5, count) so the host can
+    divide once at the end — unlike the reference's ``validate()``, which
+    averages per-batch averages over padded shards (the double-count noted
+    in SURVEY §3.4). ``mask`` is 1.0 for real examples, 0.0 for sampler
+    padding.
+    """
+
+    def eval_local(state: TrainState, images, labels, mask):
+        x = images.astype(compute_dtype)
+        p = jax.tree_util.tree_map(lambda t: t.astype(compute_dtype), state.params)
+        logits, _ = model_apply(p, state.bn_state, x, train=False, axis_name=None)
+        nll = F.cross_entropy(logits, labels, reduction="none")
+        maxk_hits = _masked_topk(logits, labels, mask)
+        sums = {
+            "loss": lax.psum(jnp.sum(nll * mask), axis),
+            "top1": lax.psum(maxk_hits[0], axis),
+            "top5": lax.psum(maxk_hits[1], axis),
+            "count": lax.psum(jnp.sum(mask), axis),
+        }
+        return sums
+
+    def _masked_topk(logits, labels, mask):
+        maxk = min(5, logits.shape[-1])  # clamp: num_classes may be < 5
+        _, pred = lax.top_k(logits.astype(jnp.float32), maxk)
+        hits = (pred == labels[:, None]).astype(jnp.float32) * mask[:, None]
+        return jnp.sum(hits[:, :1]), jnp.sum(hits[:, :maxk])
+
+    sharded = shard_map(
+        eval_local,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(axis)),
+        out_specs=P(),
+        check_rep=False,
+    )
+    return jax.jit(sharded)

@@ -1,0 +1,205 @@
+"""The trainer — TPU-native ``main_worker`` (SURVEY §1 L3).
+
+One trainer replaces all six reference scripts: on TPU, DP and DDP collapse
+into "one process per host drives all local chips, params replicated, grads
+pmean-ed" (SURVEY §7 design stance), so the reference's script matrix
+becomes config flags:
+
+==============================================  =============================
+reference script                                 config
+==============================================  =============================
+``dataparallel.py`` / ``distributed{_mp}.py``    defaults
+``dataparallel_apex.py`` / ``distributed_apex``  ``bf16=True``
+``distributed_gradient_accumulation.py``         ``grad_accu_steps=K``
+SyncBN on/off (``distributed.py:59``)            ``sync_bn``
+==============================================  =============================
+
+Preserved reference behaviors (SURVEY §7 fidelity list): per-replica batch =
+global/ N (``distributed.py:67``), epoch-seeded shuffle via ``set_epoch``
+(``:81``), per-rank+epoch augmentation seeding (``distributed_mp.py:29-39``),
+rank-0-only output, MultiStepLR/SGD hyperparameters, per-step metric
+reduction and log line (``:104-111``), epoch wall-time print (``:113-115``),
+per-epoch distributed validation. Deliberately dropped: the per-step
+``dist.barrier()`` (ordering is XLA dataflow now, SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_dist import ckpt as ckpt_lib
+from tpu_dist.comm import mesh as mesh_lib
+from tpu_dist.config import TrainConfig
+from tpu_dist.data import (
+    DataLoader,
+    DistributedSampler,
+    load_cifar100,
+    synthetic_cifar,
+    transforms,
+)
+from tpu_dist.evaluation import validate
+from tpu_dist.metrics import AverageMeter, rank0_print
+from tpu_dist.nn import resnet18, resnet34, resnet50
+from tpu_dist.train.optim import SGD, multistep_lr
+from tpu_dist.train.state import TrainState
+from tpu_dist.train.step import make_eval_step, make_train_step
+
+_MODELS = {"resnet18": resnet18, "resnet34": resnet34, "resnet50": resnet50}
+
+
+def build_model(cfg: TrainConfig):
+    try:
+        from tpu_dist.nn.vit import vit_b16, vit_s16, vit_tiny  # noqa: PLC0415
+
+        _MODELS.setdefault("vit_b16", vit_b16)
+        _MODELS.setdefault("vit_s16", vit_s16)
+        _MODELS.setdefault("vit_tiny", vit_tiny)
+    except ImportError:
+        pass
+    if cfg.model not in _MODELS:
+        raise ValueError(f"unknown model {cfg.model!r}; have {sorted(_MODELS)}")
+    return _MODELS[cfg.model](num_classes=cfg.num_classes)
+
+
+class Trainer:
+    def __init__(self, cfg: TrainConfig, mesh=None):
+        self.cfg = cfg
+        mesh_lib.initialize_distributed(
+            coordinator_address=cfg.coordinator_address if cfg.num_processes else None,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id,
+        )
+        self.mesh = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+        self.n_devices = int(self.mesh.devices.size)
+        self.model = build_model(cfg)
+
+        # -- data ------------------------------------------------------------
+        if cfg.dataset == "synthetic":
+            self.train_data = synthetic_cifar(50_000, cfg.num_classes, seed=1)
+            self.test_data = synthetic_cifar(10_000, cfg.num_classes, seed=2)
+        elif cfg.dataset == "cifar100":
+            self.train_data = load_cifar100(cfg.data_dir, train=True)
+            self.test_data = load_cifar100(cfg.data_dir, train=False)
+        else:
+            raise ValueError(f"unknown dataset {cfg.dataset!r}")
+
+        nproc, pid = mesh_lib.process_count(), mesh_lib.process_index()
+        # reference: per-worker batch = global / nprocs (distributed.py:67);
+        # here the per-process slice is further split over local chips by
+        # the batch sharding, and grad accumulation slices it once more.
+        if cfg.batch_size % self.n_devices:
+            raise ValueError(
+                f"batch_size {cfg.batch_size} must divide over {self.n_devices} devices"
+            )
+        per_device = cfg.batch_size // self.n_devices
+        if per_device % cfg.grad_accu_steps:
+            raise ValueError(
+                f"per-device batch {per_device} must divide by grad_accu_steps="
+                f"{cfg.grad_accu_steps}"
+            )
+        self.local_batch = cfg.batch_size // nproc
+        seed = cfg.seed if cfg.seed is not None else 0
+
+        self.train_sampler = DistributedSampler(
+            len(self.train_data[0]), nproc, pid, shuffle=True, seed=seed,
+            drop_last=cfg.drop_last or cfg.grad_accu_steps > 1,
+        )
+        self.test_sampler = DistributedSampler(
+            len(self.test_data[0]), nproc, pid, shuffle=False, seed=seed
+        )
+        self.train_loader = DataLoader(
+            *self.train_data, self.local_batch, self.train_sampler, self.mesh,
+            transform=transforms.train_augment, seed=seed, prefetch=cfg.num_workers,
+        )
+        self.test_loader = DataLoader(
+            *self.test_data, self.local_batch, self.test_sampler, self.mesh,
+            eval_transform=transforms.eval_transform, seed=seed, with_mask=True,
+            prefetch=cfg.num_workers,
+        )
+
+        # -- model / optimizer state ----------------------------------------
+        self.optimizer = SGD(momentum=cfg.momentum, weight_decay=cfg.weight_decay)
+        params, bn_state = self.model.init(jax.random.PRNGKey(seed))
+        state = TrainState.create(params, bn_state, self.optimizer)
+        # replicate across the mesh (DDP's init-time param broadcast)
+        self.state = jax.device_put(state, mesh_lib.replicated(self.mesh))
+        self.lr_schedule = multistep_lr(cfg.lr, cfg.lr_milestones, cfg.lr_gamma)
+
+        compute_dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
+        self.train_step = make_train_step(
+            self.model.apply, self.optimizer, self.mesh,
+            grad_accum_steps=cfg.grad_accu_steps,
+            sync_bn=cfg.sync_bn,
+            compute_dtype=compute_dtype,
+        )
+        self.eval_step = make_eval_step(
+            self.model.apply, self.mesh, compute_dtype=compute_dtype
+        )
+
+        self.start_epoch = 0
+        if cfg.resume and cfg.ckpt_dir:
+            found = ckpt_lib.latest_checkpoint(cfg.ckpt_dir)
+            if found:
+                path, epoch = found
+                restored = ckpt_lib.restore(path, state)
+                self.state = jax.device_put(restored, mesh_lib.replicated(self.mesh))
+                self.start_epoch = epoch + 1
+                rank0_print(f"=> resumed from {path} (epoch {epoch})")
+
+    # -- loops ---------------------------------------------------------------
+
+    def train_epoch(self, epoch: int) -> dict:
+        cfg = self.cfg
+        self.train_sampler.set_epoch(epoch)  # shuffle correctness (tutorials/2:§2)
+        lr = self.lr_schedule(epoch)
+        losses = AverageMeter("Loss", ":.4e")  # epoch-avg of the logged steps
+        images_seen = 0
+        t0 = time.time()
+        nb = len(self.train_loader)
+        metrics = {}
+        for step, (images, labels) in enumerate(self.train_loader):
+            if cfg.steps_per_epoch is not None and step >= cfg.steps_per_epoch:
+                break
+            self.state, metrics = self.train_step(self.state, images, labels, lr)
+            images_seen += cfg.batch_size
+            if step % cfg.log_every == 0:
+                m = {k: float(v) for k, v in metrics.items()}  # device sync
+                losses.update(m["loss"], cfg.batch_size)
+                # reference per-step line (distributed.py:104-111)
+                rank0_print(
+                    f"Epoch:[{epoch}/{cfg.epochs}] step:[{step}/{nb}] "
+                    f"lr={lr:.5f} loss={m['loss']:.4f} "
+                    f"acc1={m['acc1']:.2f} acc5={m['acc5']:.2f}"
+                )
+        jax.block_until_ready(self.state.params)
+        dt = time.time() - t0
+        ips = images_seen / dt if dt > 0 else 0.0
+        # reference epoch wall-time print (distributed.py:113-115)
+        rank0_print(
+            f"Epoch {epoch} done in {dt:.2f}s ({ips:.0f} img/s, avg loss {losses.avg:.4f})"
+        )
+        out = {k: float(v) for k, v in metrics.items()} if metrics else {}
+        out.update(epoch_time=dt, images_per_sec=ips)
+        return out
+
+    def fit(self, epochs: Optional[int] = None) -> dict:
+        cfg = self.cfg
+        epochs = epochs if epochs is not None else cfg.epochs
+        last = {}
+        for epoch in range(self.start_epoch, epochs):
+            last = self.train_epoch(epoch)
+            if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
+                t1, t5, vloss = validate(
+                    self.test_loader, self.state, self.eval_step, epoch=epoch
+                )
+                last.update(val_top1=t1, val_top5=t5, val_loss=vloss)
+            if cfg.ckpt_dir and (epoch + 1) % cfg.save_every == 0:
+                ckpt_lib.save(cfg.ckpt_dir, self.state, epoch)
+        if cfg.ckpt_dir:
+            ckpt_lib.save(cfg.ckpt_dir, self.state, epochs - 1)
+        return last
