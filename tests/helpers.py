@@ -1,0 +1,58 @@
+"""Shared test fixtures: tiny models that compile fast on the emulated mesh."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist.nn import layers as L
+from tpu_dist.nn.resnet import ResNetDef
+
+
+def tiny_resnet(num_classes: int = 10) -> ResNetDef:
+    """Reference ResNet topology at 1/8 width — same code paths, ~40x fewer
+    FLOPs, seconds to compile on the 8-device CPU mesh."""
+    return ResNetDef("basic", (1, 1, 1, 1), num_classes, widths=(8, 8, 16, 16))
+
+
+class TinyConvNet:
+    """conv+bn+fc micro-model exercising every layer primitive."""
+
+    def __init__(self, num_classes: int = 10, width: int = 8):
+        self.num_classes = num_classes
+        self.width = width
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        params = {"conv": L.conv_init(k1, 3, self.width, 3)}
+        params["bn"], bn_state = L.bn_init(self.width)
+        params["fc"] = L.linear_init(k2, self.width, self.num_classes)
+        return params, {"bn": bn_state}
+
+    def apply(self, params, state, x, *, train=False, axis_name=None):
+        y = L.conv_apply(params["conv"], x, 1, 1)
+        y, ns = L.bn_apply(params["bn"], state["bn"], y, train=train, axis_name=axis_name)
+        y = L.relu(y)
+        y = L.global_avg_pool(y)
+        return L.linear_apply(params["fc"], y), {"bn": ns}
+
+
+class TinyMLP:
+    """BN-free model: exact arithmetic equivalence tests (grad accum, DP)."""
+
+    def __init__(self, num_classes: int = 10, width: int = 16, in_dim: int = 12):
+        self.num_classes = num_classes
+        self.width = width
+        self.in_dim = in_dim
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        return {
+            "l1": L.linear_init(k1, self.in_dim, self.width),
+            "l2": L.linear_init(k2, self.width, self.num_classes),
+        }, {}
+
+    def apply(self, params, state, x, *, train=False, axis_name=None):
+        x = x.reshape(x.shape[0], -1)
+        y = L.relu(L.linear_apply(params["l1"], x))
+        return L.linear_apply(params["l2"], y), state

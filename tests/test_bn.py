@@ -1,0 +1,83 @@
+"""SyncBatchNorm: cross-replica statistics (reference ``distributed.py:59``,
+SURVEY §2.2 N5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from tpu_dist.comm import mesh as mesh_lib
+from tpu_dist.nn import layers as L
+
+
+def _run_bn(x_global, axis_name):
+    mesh = mesh_lib.data_parallel_mesh()
+    params, state = L.bn_init(x_global.shape[-1])
+
+    def f(p, s, x):
+        y, ns = L.bn_apply(p, s, x, train=True, axis_name=axis_name)
+        return y, ns
+
+    sharded = jax.jit(
+        shard_map(
+            f, mesh=mesh,
+            in_specs=(P(), P(), P("data")),
+            out_specs=(P("data"), P("data") if axis_name is None else P()),
+            check_vma=False,
+        )
+    )
+    return sharded(params, state, x_global)
+
+
+def test_sync_bn_normalizes_with_global_stats():
+    # per-replica distributions differ wildly; only SYNC BN centers globally
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 4, 4, 3)).astype(np.float32)
+    x[:8] += 10.0  # first replicas see shifted data
+
+    y_sync, _ = _run_bn(x, "data")
+    y = np.asarray(y_sync)
+    # global mean of normalized output ~ 0, var ~ 1
+    np.testing.assert_allclose(y.mean(axis=(0, 1, 2)), 0.0, atol=1e-4)
+    np.testing.assert_allclose(y.var(axis=(0, 1, 2)), 1.0, atol=1e-3)
+    # within the shifted half, mean stays clearly positive (global stats used)
+    assert y[:8].mean() > 0.5
+
+
+def test_local_bn_normalizes_per_replica():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 4, 4, 3)).astype(np.float32)
+    x[:8] += 10.0
+
+    y_local, _ = _run_bn(x, None)
+    y = np.asarray(y_local)
+    # each replica normalized independently -> both halves centered
+    np.testing.assert_allclose(y[:8].mean(), 0.0, atol=1e-3)
+    np.testing.assert_allclose(y[8:].mean(), 0.0, atol=1e-3)
+
+
+def test_sync_bn_running_stats_match_global_batch():
+    rng = np.random.default_rng(1)
+    x = rng.normal(loc=2.0, scale=3.0, size=(32, 2, 2, 5)).astype(np.float32)
+    _, ns = _run_bn(x, "data")
+    mean = np.asarray(ns["mean"])
+    got = mean / L.BN_MOMENTUM  # running = 0.9*0 + 0.1*batch_mean
+    np.testing.assert_allclose(got, x.mean(axis=(0, 1, 2)), rtol=1e-4, atol=1e-4)
+    n = x.size // x.shape[-1]
+    var_unbiased = x.var(axis=(0, 1, 2)) * n / (n - 1)
+    np.testing.assert_allclose(
+        np.asarray(ns["var"]) - 0.9, 0.1 * var_unbiased, rtol=1e-3, atol=1e-4
+    )
+
+
+def test_bn_eval_matches_torch_formula():
+    params, state = L.bn_init(3)
+    params = {"scale": jnp.array([1.0, 2.0, 0.5]), "bias": jnp.array([0.0, 1.0, -1.0])}
+    state = {"mean": jnp.array([0.5, -0.5, 0.0]), "var": jnp.array([4.0, 1.0, 0.25])}
+    x = jnp.ones((2, 2, 2, 3))
+    y, _ = L.bn_apply(params, state, x, train=False)
+    expect = (np.ones(3) - np.array([0.5, -0.5, 0.0])) / np.sqrt(
+        np.array([4.0, 1.0, 0.25]) + 1e-5
+    ) * np.array([1.0, 2.0, 0.5]) + np.array([0.0, 1.0, -1.0])
+    np.testing.assert_allclose(np.asarray(y)[0, 0, 0], expect, rtol=1e-5, atol=1e-6)

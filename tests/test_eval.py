@@ -1,0 +1,63 @@
+"""Distributed evaluation: exact counts, pad masking (fix of SURVEY §3.4)."""
+
+import jax
+import numpy as np
+
+from tpu_dist.comm import mesh as mesh_lib
+from tpu_dist.train.optim import SGD
+from tpu_dist.train.state import TrainState
+from tpu_dist.train.step import make_eval_step
+from tests.helpers import TinyConvNet
+
+
+def test_eval_sums_ignore_padding():
+    model = TinyConvNet(num_classes=10)
+    mesh = mesh_lib.data_parallel_mesh()
+    params, bn = model.init(jax.random.PRNGKey(0))
+    state = jax.device_put(
+        TrainState.create(params, bn, SGD()), mesh_lib.replicated(mesh)
+    )
+    eval_step = make_eval_step(model.apply, mesh)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8, 8, 3)).astype(np.float32)
+    y = rng.integers(0, 10, 64).astype(np.int32)
+
+    full = np.ones(64, np.float32)
+    half = np.concatenate([np.ones(32, np.float32), np.zeros(32, np.float32)])
+
+    s_full = {k: float(v) for k, v in eval_step(
+        state, *map(lambda a: mesh_lib.shard_batch(mesh, a), (x, y, full))).items()}
+    s_half = {k: float(v) for k, v in eval_step(
+        state, *map(lambda a: mesh_lib.shard_batch(mesh, a), (x, y, half))).items()}
+
+    assert s_full["count"] == 64 and s_half["count"] == 32
+
+    # masked half must equal evaluating only the first 32 (padded duplicates
+    # contribute nothing) — this is exactly what the reference got wrong
+    x32 = np.concatenate([x[:32], x[:32]])  # duplicates in padding slots
+    y32 = np.concatenate([y[:32], y[:32]])
+    s_dup = {k: float(v) for k, v in eval_step(
+        state, *map(lambda a: mesh_lib.shard_batch(mesh, a), (x32, y32, half))).items()}
+    np.testing.assert_allclose(s_dup["loss"], s_half["loss"], rtol=1e-5)
+    assert s_dup["top1"] == s_half["top1"]
+
+
+def test_eval_top1_matches_numpy():
+    model = TinyConvNet(num_classes=10)
+    mesh = mesh_lib.data_parallel_mesh()
+    params, bn = model.init(jax.random.PRNGKey(0))
+    state = jax.device_put(
+        TrainState.create(params, bn, SGD()), mesh_lib.replicated(mesh)
+    )
+    eval_step = make_eval_step(model.apply, mesh)
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(64, 8, 8, 3)).astype(np.float32)
+    y = rng.integers(0, 10, 64).astype(np.int32)
+    logits, _ = model.apply(params, bn, x, train=False)
+    expect_top1 = int((np.argmax(np.asarray(logits), -1) == y).sum())
+
+    sums = eval_step(state, *map(lambda a: mesh_lib.shard_batch(mesh, a),
+                                 (x, y, np.ones(64, np.float32))))
+    assert int(float(sums["top1"])) == expect_top1

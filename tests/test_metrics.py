@@ -1,0 +1,62 @@
+"""Meter math and accuracy parity with the reference kit (``utils/util.py``)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from tpu_dist.metrics.meters import AverageMeter, ProgressMeter
+from tpu_dist.nn import functional as F
+
+
+def test_average_meter():
+    m = AverageMeter("loss", ":.2f")
+    m.update(2.0, n=2)
+    m.update(4.0, n=2)
+    assert m.val == 4.0
+    assert m.sum == 12.0
+    assert m.count == 4
+    assert m.avg == 3.0
+    assert "loss" in str(m)
+    m.reset()
+    assert m.count == 0
+
+
+def test_progress_meter_format():
+    m = AverageMeter("Loss", ":.1f")
+    m.update(1.5)
+    p = ProgressMeter(196, m, prefix="Epoch: ")
+    line = p.display(12)
+    assert "[ 12/196]" in line and "Loss" in line
+
+
+def test_accuracy_matches_torch_reference():
+    """accuracy(output, target, topk) parity with utils/util.py:50-64."""
+    import torch
+
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(32, 100)).astype(np.float32)
+    labels = rng.integers(0, 100, 32)
+
+    # reference implementation, transcribed semantics: topk -> eq -> ratio
+    tl = torch.tensor(logits)
+    tt = torch.tensor(labels)
+    _, pred = tl.topk(5, 1, True, True)
+    correct = pred.t().eq(tt.view(1, -1).expand_as(pred.t()))
+    ref1 = correct[:1].reshape(-1).float().sum(0) * 100.0 / 32
+    ref5 = correct[:5].reshape(-1).float().sum(0) * 100.0 / 32
+
+    a1, a5 = F.accuracy(jnp.array(logits), jnp.array(labels), topk=(1, 5))
+    np.testing.assert_allclose(float(a1), float(ref1), rtol=1e-5)
+    np.testing.assert_allclose(float(a5), float(ref5), rtol=1e-5)
+
+
+def test_cross_entropy_matches_torch():
+    import torch
+
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(16, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, 16)
+    ref = torch.nn.functional.cross_entropy(
+        torch.tensor(logits), torch.tensor(labels)
+    ).item()
+    got = float(F.cross_entropy(jnp.array(logits), jnp.array(labels)))
+    np.testing.assert_allclose(got, ref, rtol=1e-5)

@@ -1,0 +1,57 @@
+"""Model-zoo parity tests against the reference ``utils/model.py``.
+
+Golden numbers computed once from the reference implementation (torch):
+parameter counts for resnet18/34/50 with num_classes=100, and BN buffer
+counts minus the ``num_batches_tracked`` scalars torch adds per BN layer.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_dist.nn import resnet18, resnet34, resnet50
+from tests.helpers import tiny_resnet
+
+# (factory, n_params, n_bn_stats): from reference utils/model.py via torch —
+# params exactly equal; torch "buffers" additionally count one
+# num_batches_tracked scalar per BN layer (20/36/53 layers respectively).
+GOLDEN = [
+    (resnet18, 11_220_132, 9_620 - 20),
+    (resnet34, 21_328_292, 17_060 - 36),
+    (resnet50, 23_705_252, 53_173 - 53),
+]
+
+
+@pytest.mark.parametrize("factory,n_params,n_stats", GOLDEN)
+def test_param_count_parity(factory, n_params, n_stats):
+    params, state = factory().init(jax.random.PRNGKey(0))
+    assert sum(x.size for x in jax.tree_util.tree_leaves(params)) == n_params
+    assert sum(x.size for x in jax.tree_util.tree_leaves(state)) == n_stats
+
+
+def test_forward_shapes_and_finiteness():
+    m = tiny_resnet(num_classes=7)
+    params, state = m.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    logits, new_state = m.apply(params, state, x, train=True)
+    assert logits.shape == (4, 7)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+    # BN running stats must have moved off their init under train=True
+    assert not jnp.allclose(new_state["stem_bn"]["mean"], 0.0)
+    # eval mode must not mutate state
+    logits2, state2 = m.apply(params, new_state, x, train=False)
+    assert jax.tree_util.tree_all(
+        jax.tree_util.tree_map(lambda a, b: bool(jnp.all(a == b)), state2, new_state)
+    )
+
+
+def test_eval_uses_running_stats():
+    m = tiny_resnet()
+    params, state = m.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    e1, _ = m.apply(params, state, x, train=False)
+    # different batch statistics shouldn't matter in eval mode
+    e2, _ = m.apply(params, state, x * 3.0 + 1.0, train=False)
+    assert e1.shape == e2.shape
+    t1, _ = m.apply(params, state, x, train=True)
+    assert not jnp.allclose(e1, t1)  # train normalizes by batch stats

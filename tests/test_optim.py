@@ -1,0 +1,42 @@
+"""Optimizer parity with ``torch.optim.SGD(lr, momentum=0.9, weight_decay=1e-4)``
+(reference ``distributed.py:63``) and MultiStepLR (``:64``)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from tpu_dist.train.optim import SGD, multistep_lr
+
+
+def test_sgd_matches_torch_semantics():
+    import torch
+
+    w0 = np.random.default_rng(0).normal(size=(5, 3)).astype(np.float32)
+
+    # torch ground truth
+    tw = torch.nn.Parameter(torch.tensor(w0.copy()))
+    opt = torch.optim.SGD([tw], lr=0.1, momentum=0.9, weight_decay=1e-4)
+    grads = [np.random.default_rng(i + 1).normal(size=w0.shape).astype(np.float32) for i in range(4)]
+    for g in grads:
+        opt.zero_grad()
+        tw.grad = torch.tensor(g.copy())
+        opt.step()
+
+    # ours
+    sgd = SGD(momentum=0.9, weight_decay=1e-4)
+    p = {"w": jnp.array(w0)}
+    b = sgd.init(p)
+    for g in grads:
+        p, b = sgd.update({"w": jnp.array(g)}, b, p, 0.1)
+
+    np.testing.assert_allclose(np.asarray(p["w"]), tw.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_multistep_lr_schedule():
+    sched = multistep_lr(0.1, (60, 120, 160), 0.2)
+    assert sched(0) == 0.1
+    assert sched(59) == 0.1
+    assert np.isclose(sched(60), 0.02)
+    assert np.isclose(sched(119), 0.02)
+    assert np.isclose(sched(120), 0.004)
+    assert np.isclose(sched(160), 0.0008)
+    assert np.isclose(sched(199), 0.0008)

@@ -1,0 +1,72 @@
+"""Trainer end-to-end on the emulated mesh, with a registered tiny model."""
+
+import numpy as np
+
+from tpu_dist.config import TrainConfig
+from tpu_dist.train.trainer import Trainer, register_model
+from tests.helpers import tiny_resnet
+
+register_model("tiny_resnet", lambda num_classes=10: tiny_resnet(num_classes))
+
+
+def _cfg(**kw):
+    base = dict(
+        dataset="synthetic", model="tiny_resnet", num_classes=10,
+        batch_size=64, epochs=1, steps_per_epoch=4, log_every=10,
+        eval_every=0, lr=0.1, seed=0,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def test_fit_trains_and_checkpoints(tmp_path):
+    cfg = _cfg(ckpt_dir=str(tmp_path), save_every=1, eval_every=1)
+    t = Trainer(cfg)
+    # shrink the eval set so the run stays fast
+    t.test_data = (t.test_data[0][:128], t.test_data[1][:128])
+    from tpu_dist.data import DataLoader, DistributedSampler, transforms
+
+    t.test_sampler = DistributedSampler(128, 1, 0, shuffle=False, seed=0)
+    t.test_loader = DataLoader(
+        *t.test_data, t.local_batch, t.test_sampler, t.mesh,
+        eval_transform=transforms.eval_transform, with_mask=True,
+    )
+    out = t.fit()
+    assert np.isfinite(out["loss"])
+    assert "val_top1" in out
+    assert (tmp_path / "ckpt_0.npz").exists()
+
+    # resume continues from the saved epoch
+    t2 = Trainer(cfg.replace(resume=True, epochs=2))
+    assert t2.start_epoch == 1
+
+
+def test_grad_accum_config_path():
+    t = Trainer(_cfg(grad_accu_steps=2, batch_size=64))
+    out = t.train_epoch(0)
+    assert np.isfinite(out["loss"])
+
+
+def test_invalid_grad_accum_rejected():
+    import pytest
+
+    with pytest.raises(ValueError, match="grad_accu_steps"):
+        Trainer(_cfg(batch_size=8, grad_accu_steps=3))
+
+
+def test_config_argparse_bridge():
+    import argparse
+
+    from tpu_dist.config import add_reference_flags, config_from_args
+
+    p = add_reference_flags(argparse.ArgumentParser())
+    args = p.parse_args(
+        ["--batch_size", "128", "--lr", "0.05", "--grad_accu_steps", "4",
+         "--bf16", "--no_sync_bn", "--seed", "3"]
+    )
+    cfg = config_from_args(args)
+    assert cfg.batch_size == 128 and cfg.lr == 0.05
+    assert cfg.grad_accu_steps == 4 and cfg.bf16 and not cfg.sync_bn
+    assert cfg.seed == 3
+    # reference-compat flags accepted silently
+    p.parse_args(["--local_rank", "2", "--gpu", "0,1"])

@@ -25,11 +25,17 @@ from tpu_dist.nn import layers as L
 
 @dataclass(frozen=True)
 class ResNetDef:
-    """Static model description; ``init``/``apply`` close over it."""
+    """Static model description; ``init``/``apply`` close over it.
+
+    ``widths`` defaults to the reference's stage widths
+    (``utils/model.py:72-75``); narrower widths give the test suite a
+    fast-compiling miniature with identical code paths.
+    """
 
     block: str  # "basic" | "bottleneck"
     stage_blocks: Tuple[int, int, int, int]
     num_classes: int = 100
+    widths: Tuple[int, int, int, int] = (64, 128, 256, 512)
 
     @property
     def expansion(self) -> int:
@@ -43,12 +49,13 @@ class ResNetDef:
         params = {}
         state = {}
 
-        params["stem_conv"] = L.conv_init(next(keys), 3, 64, 3, dtype)
-        params["stem_bn"], state["stem_bn"] = L.bn_init(64, dtype)
+        stem = self.widths[0]
+        params["stem_conv"] = L.conv_init(next(keys), 3, stem, 3, dtype)
+        params["stem_bn"], state["stem_bn"] = L.bn_init(stem, dtype)
 
-        in_ch = 64
+        in_ch = stem
         for si, (width, n_blocks, stride) in enumerate(
-            zip((64, 128, 256, 512), self.stage_blocks, (1, 2, 2, 2))
+            zip(self.widths, self.stage_blocks, (1, 2, 2, 2))
         ):
             blocks_p: List[dict] = []
             blocks_s: List[dict] = []
@@ -60,7 +67,9 @@ class ResNetDef:
             params[f"stage{si + 1}"] = blocks_p
             state[f"stage{si + 1}"] = blocks_s
 
-        params["fc"] = L.linear_init(next(keys), 512 * self.expansion, self.num_classes, dtype)
+        params["fc"] = L.linear_init(
+            next(keys), self.widths[-1] * self.expansion, self.num_classes, dtype
+        )
         return params, state
 
     def _block_init(self, key, in_ch, width, stride, dtype):

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from tpu_dist.comm import mesh as mesh_lib
 from tpu_dist.nn import functional as F
@@ -129,7 +129,7 @@ def make_train_step(
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P()),
         out_specs=(P(), P()),
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
@@ -175,6 +175,6 @@ def make_eval_step(
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P(axis)),
         out_specs=P(),
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(sharded)

@@ -52,6 +52,14 @@ from tpu_dist.train.step import make_eval_step, make_train_step
 _MODELS = {"resnet18": resnet18, "resnet34": resnet34, "resnet50": resnet50}
 
 
+def register_model(name: str, factory) -> None:
+    """Extend the model zoo (``factory(num_classes=...) -> model`` with
+    ``init``/``apply``). Lets users swap models the way the reference
+    suggests swapping ``utils/model.py`` (BASELINE north star's ViT config).
+    """
+    _MODELS[name] = factory
+
+
 def build_model(cfg: TrainConfig):
     try:
         from tpu_dist.nn.vit import vit_b16, vit_s16, vit_tiny  # noqa: PLC0415
