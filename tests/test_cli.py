@@ -1,0 +1,57 @@
+"""CLI layer: flag parsing, presets, trainer wiring (SURVEY §1 L4)."""
+
+import pytest
+
+from tpu_dist.cli import (
+    dataparallel,
+    dataparallel_apex,
+    distributed,
+    distributed_apex,
+    distributed_gradient_accumulation,
+    distributed_mp,
+    train,
+)
+
+
+def test_train_cli_constructs_trainer_and_runs_zero_epochs(capsys):
+    # epochs=0: full CLI -> config -> Trainer init path without jit compiles
+    train.main(["--epochs", "0", "--dataset", "synthetic", "--batch_size", "64"])
+    out = capsys.readouterr().out
+    assert "model=resnet18" in out and "devices=8" in out
+
+
+def test_presets_set_their_flags(monkeypatch):
+    seen = {}
+
+    def fake_main(argv=None, **preset):
+        seen["argv"] = list(argv or [])
+        seen["preset"] = preset
+
+    for mod, expect_preset, expect_argv in [
+        (dataparallel, {}, []),
+        (dataparallel_apex, {"bf16": True}, []),
+        (distributed, {}, []),
+        (distributed_mp, {}, ["--seed", "1"]),
+        (distributed_apex, {"bf16": True}, ["--seed", "1"]),
+        (
+            distributed_gradient_accumulation,
+            {"drop_last": True},
+            ["--grad_accu_steps", "4"],
+        ),
+    ]:
+        monkeypatch.setattr(mod, "_main", fake_main)
+        mod.main([])
+        assert seen["preset"] == expect_preset, mod.__name__
+        assert seen["argv"] == expect_argv, mod.__name__
+
+
+def test_seed_flag_not_overridden_by_preset(monkeypatch):
+    seen = {}
+    monkeypatch.setattr(distributed_mp, "_main", lambda argv=None, **p: seen.update(argv=argv))
+    distributed_mp.main(["--seed", "7"])
+    assert seen["argv"] == ["--seed", "7"]
+
+
+def test_unknown_flag_fails_loud():
+    with pytest.raises(SystemExit):
+        train.main(["--definitely_not_a_flag"])

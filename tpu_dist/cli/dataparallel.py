@@ -1,0 +1,17 @@
+"""DP preset (reference ``dataparallel.py``: single-process multi-device via
+``nn.DataParallel``, ``dataparallel.py:47``).
+
+On TPU the single-controller model IS the native mode — one process drives
+all local chips — so this is the plain trainer. ``--gpu`` is accepted for
+command-line parity and ignored (device selection is the TPU slice).
+"""
+
+from tpu_dist.cli.train import main as _main
+
+
+def main(argv=None):
+    _main(argv)
+
+
+if __name__ == "__main__":
+    main()

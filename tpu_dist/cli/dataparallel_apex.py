@@ -1,0 +1,13 @@
+"""DP + AMP preset (reference ``dataparallel_apex.py``: ``amp.initialize`` at
+``:53``, ``amp.scale_loss`` at ``:86-87``). AMP ≡ bf16 compute policy on TPU
+(no loss scaling needed — bf16 has fp32's exponent range)."""
+
+from tpu_dist.cli.train import main as _main
+
+
+def main(argv=None):
+    _main(argv, bf16=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""DDP preset (reference ``distributed.py``, launched via
+``torch.distributed.launch``). ``--local_rank`` is accepted for parity and
+ignored — on TPU, process↔chip mapping comes from slice discovery
+(``jax.distributed.initialize``), not an injected flag (SURVEY §3.5)."""
+
+from tpu_dist.cli.train import main as _main
+
+
+def main(argv=None):
+    _main(argv)
+
+
+if __name__ == "__main__":
+    main()

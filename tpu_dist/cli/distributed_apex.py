@@ -1,0 +1,18 @@
+"""DDP + apex preset (reference ``distributed_apex.py``: apex AMP ``:86``,
+apex fused SyncBN ``:85``). bf16 compute + the pmean-based SyncBN (on by
+default) are the TPU equivalents; seeding matches ``init_seeds`` (``:40-50``)."""
+
+from tpu_dist.cli.train import main as _main
+
+
+def main(argv=None):
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not any(a.startswith("--seed") for a in argv):
+        argv += ["--seed", "1"]
+    _main(argv, bf16=True)
+
+
+if __name__ == "__main__":
+    main()

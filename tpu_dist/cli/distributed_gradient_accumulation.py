@@ -1,0 +1,23 @@
+"""Gradient-accumulation preset (reference
+``distributed_gradient_accumulation.py``): per-rank batch split into
+``--grad_accu_steps`` sub-batches (``:77,90-98``), allreduce suppressed on
+non-boundary sub-steps (``no_sync``, ``:106``), loss scaled 1/K
+(``:103,110``), one optimizer step per outer step (``:118``),
+``drop_last=True`` loader (``:71``). Defaults ``--grad_accu_steps 4`` (the
+reference flag at ``:26`` defaults to 1, i.e. no accumulation; this preset
+exists to exercise accumulation, so it picks 4)."""
+
+from tpu_dist.cli.train import main as _main
+
+
+def main(argv=None):
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not any(a.startswith("--grad_accu_steps") for a in argv):
+        argv += ["--grad_accu_steps", "4"]
+    _main(argv, drop_last=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""Spawn-mode DDP preset (reference ``distributed_mp.py``, the repo's
+recommended path, ``README.md:197-198``). There is nothing to spawn on TPU —
+one process per host already owns all local chips — so this differs from
+``distributed`` only in enabling the reference's per-rank deterministic
+seeding (``init_seeds(local_rank+1)``, ``distributed_mp.py:29-39,56``) by
+defaulting ``--seed 1``."""
+
+from tpu_dist.cli.train import main as _main
+
+
+def main(argv=None):
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not any(a.startswith("--seed") for a in argv):
+        argv += ["--seed", "1"]
+    _main(argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,7 @@ class DataLoader:
         mesh: Mesh,
         transform: Optional[Callable[[np.ndarray, np.random.Generator], np.ndarray]] = None,
         eval_transform: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+        gather_transform: Optional[Callable] = None,
         seed: int = 0,
         prefetch: int = 2,
         with_mask: bool = False,
@@ -45,7 +46,12 @@ class DataLoader:
         """``batch_size`` is the PER-PROCESS batch (the reference's manual
         ``global_batch / nprocs`` split, ``distributed.py:67``, happens in
         the trainer). ``with_mask`` adds the sampler's pad mask to each batch
-        for exact distributed eval."""
+        for exact distributed eval.
+
+        ``gather_transform(images, sel, seed=...)`` is the fused fast path
+        (gather + augment + normalize in one pass — the native C++ pipeline,
+        ``tpu_dist.data.native.gather_augment``); when given it replaces
+        ``transform``/``eval_transform``."""
         n_local = mesh_lib.local_device_count()
         if batch_size % n_local:
             raise ValueError(
@@ -58,6 +64,7 @@ class DataLoader:
         self.mesh = mesh
         self.transform = transform
         self.eval_transform = eval_transform
+        self.gather_transform = gather_transform
         self.seed = seed
         self.prefetch = max(1, prefetch)
         self.with_mask = with_mask
@@ -85,11 +92,16 @@ class DataLoader:
                 sel = np.concatenate([sel, np.repeat(sel[-1:], pad)])
                 if bmask is not None:
                     bmask = np.concatenate([bmask, np.zeros(pad, bool)])
-            imgs = self.images[sel]
-            if self.transform is not None:
-                imgs = self.transform(imgs, rng)
-            elif self.eval_transform is not None:
-                imgs = self.eval_transform(imgs)
+            if self.gather_transform is not None:
+                imgs = self.gather_transform(
+                    self.images, sel, seed=int(rng.integers(0, 2**63))
+                )
+            else:
+                imgs = self.images[sel]
+                if self.transform is not None:
+                    imgs = self.transform(imgs, rng)
+                elif self.eval_transform is not None:
+                    imgs = self.eval_transform(imgs)
             out = (imgs, self.labels[sel])
             if self.with_mask:
                 out = out + (bmask.astype(np.float32),)

@@ -1,0 +1,121 @@
+"""ctypes bridge to the native C++ input pipeline (``tpu_dist/csrc``).
+
+The reference leans on native code for its input path (torchvision's C
+extensions + DataLoader worker processes, SURVEY §2.2 N7); this module is
+the TPU build's equivalent: a fused gather+pad+crop+normalize over the
+batch in multi-threaded C++. Falls back to the numpy implementation in
+``tpu_dist.data.transforms`` when the shared library isn't built.
+
+Build once with ``make -C tpu_dist/csrc`` — or let :func:`ensure_built`
+compile it on first use (cached; failures degrade to numpy silently but
+are reported by :func:`available`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from tpu_dist.data import transforms
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "csrc")
+_SO = os.path.join(_CSRC, "build", "libtpu_dist_pipeline.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO):
+            try:  # build on first use; tolerate missing toolchain
+                subprocess.run(
+                    ["make", "-C", _CSRC],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            lib.tpu_dist_augment_batch.restype = ctypes.c_int
+            lib.tpu_dist_augment_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),   # images
+                ctypes.POINTER(ctypes.c_int64),   # indices
+                ctypes.POINTER(ctypes.c_float),   # out
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64,                   # pad
+                ctypes.c_uint64,                  # seed
+                ctypes.POINTER(ctypes.c_float),   # mean
+                ctypes.POINTER(ctypes.c_float),   # std
+                ctypes.c_int,                     # train
+                ctypes.c_int,                     # n_threads
+            ]
+            if lib.tpu_dist_pipeline_abi_version() != 1:
+                return None
+            _lib = lib
+        except OSError:
+            return None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def gather_augment(
+    images: np.ndarray,
+    indices: np.ndarray,
+    *,
+    seed: int,
+    train: bool,
+    padding: int = 4,
+    mean: np.ndarray = transforms.CIFAR100_MEAN,
+    std: np.ndarray = transforms.CIFAR100_STD,
+    n_threads: int = 0,
+) -> np.ndarray:
+    """Fused ``normalize(random_crop(images[indices]))`` → f32 NHWC batch.
+
+    Uses the C++ pipeline when built; otherwise the numpy reference path
+    (identical semantics, different crop-offset RNG stream).
+    """
+    lib = _load()
+    n = len(indices)
+    _, h, w, c = images.shape
+    if lib is not None:
+        images = np.ascontiguousarray(images)
+        idx = np.ascontiguousarray(indices, np.int64)
+        out = np.empty((n, h, w, c), np.float32)
+        mean32 = np.ascontiguousarray(mean, np.float32)
+        std32 = np.ascontiguousarray(std, np.float32)
+        rc = lib.tpu_dist_augment_batch(
+            images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, h, w, c,
+            padding if train else 0,
+            np.uint64(seed & 0xFFFFFFFFFFFFFFFF),
+            mean32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            std32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            1 if train else 0,
+            n_threads,
+        )
+        if rc == 0:
+            return out
+    # numpy fallback
+    batch = images[indices]
+    if train:
+        rng = np.random.default_rng(seed)
+        batch = transforms.random_crop_batch(batch, rng, padding)
+    return (batch.astype(np.float32) / 255.0 - mean) / std

@@ -25,6 +25,7 @@ per-epoch distributed validation. Deliberately dropped: the per-step
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Optional
 
@@ -120,14 +121,18 @@ class Trainer:
         self.test_sampler = DistributedSampler(
             len(self.test_data[0]), nproc, pid, shuffle=False, seed=seed
         )
+        # fused C++ gather+crop+normalize when built; numpy otherwise
+        from tpu_dist.data import native  # noqa: PLC0415
+
         self.train_loader = DataLoader(
             *self.train_data, self.local_batch, self.train_sampler, self.mesh,
-            transform=transforms.train_augment, seed=seed, prefetch=cfg.num_workers,
+            gather_transform=functools.partial(native.gather_augment, train=True),
+            seed=seed, prefetch=cfg.num_workers,
         )
         self.test_loader = DataLoader(
             *self.test_data, self.local_batch, self.test_sampler, self.mesh,
-            eval_transform=transforms.eval_transform, seed=seed, with_mask=True,
-            prefetch=cfg.num_workers,
+            gather_transform=functools.partial(native.gather_augment, train=False),
+            seed=seed, with_mask=True, prefetch=cfg.num_workers,
         )
 
         # -- model / optimizer state ----------------------------------------
