@@ -1,0 +1,107 @@
+"""Attention: full (single-device) and ring (sequence-parallel) variants.
+
+The reference has no attention code at all (SURVEY §2.3: models are conv
+ResNets); its BASELINE north star adds ViT-B/16 as a data-parallel stress
+test. This module goes further and makes long-context support first-class,
+TPU-style:
+
+* :func:`full_attention` — plain softmax attention; one fused XLA op chain,
+  MXU-friendly einsums, f32 softmax accumulation under bf16 compute.
+* :func:`ring_attention` — sequence parallelism over a mesh axis: Q stays
+  local while K/V blocks rotate around the ring via ``lax.ppermute``
+  (ICI-neighbor traffic only), with flash-style online-softmax accumulation
+  so the full [S, S] score matrix never materializes. Per-device memory is
+  O(S_local · S_block) and the sequence dimension scales with the number of
+  devices on the axis. Combine with the ``data`` axis on a 2-D mesh for
+  DP × SP.
+
+Both operate on [B, S, H, D] (batch, sequence, heads, head_dim) and are
+shape-polymorphic under ``shard_map``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def full_attention(q, k, v, *, causal: bool = False):
+    """[B,S,H,D] x3 → [B,S,H,D]. Softmax in f32 regardless of input dtype."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(d))
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = False):
+    """Sequence-parallel attention over ``axis_name`` (ring / all-to-all CP).
+
+    Inside ``shard_map`` with the sequence dim sharded over ``axis_name``:
+    every device holds local Q/K/V blocks of shape [B, S/n, H, D]. K/V
+    rotate n times around the ring (``lax.ppermute`` to the next neighbor —
+    nearest-neighbor ICI traffic, overlapped by XLA with the block matmuls);
+    attention is accumulated with the numerically-stable online softmax
+    (running max ``m``, normalizer ``l``, accumulator ``acc``).
+
+    ``causal`` masks by GLOBAL position: block order on the axis is the
+    sequence order (device i holds positions [i·S/n, (i+1)·S/n)).
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+
+    qf = q.astype(jnp.float32)
+
+    def block(scores_kv, kv_idx):
+        """Scores of local Q against the K/V block originating at kv_idx."""
+        kk, vv = scores_kv
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kk.astype(jnp.float32)) * scale
+        if causal:
+            q_pos = my * s_loc + jnp.arange(s_loc)[:, None]        # [Sq,1]
+            k_pos = kv_idx * s_loc + jnp.arange(s_loc)[None, :]    # [1,Sk]
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        return s, vv
+
+    def body(carry, _):
+        m, l, acc, kk, vv, kv_idx = carry
+        s, vv_f = block((kk, vv), kv_idx)
+        m_new = jnp.maximum(m, s.max(axis=-1))                     # [B,H,Sq]
+        # guard: fully-masked rows keep m at -inf; exp(-inf - -inf) → use 0
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        l_new = l * corr + p.sum(axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vv_f.astype(jnp.float32)
+        )
+        # rotate K/V to the next ring position
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        kv_idx = (kv_idx - 1) % n
+        return (m_new, l_new, acc, kk, vv, kv_idx), None
+
+    m0 = jnp.full((b, h, s_loc), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    acc0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    (m, l, acc, _, _, _), _ = lax.scan(
+        body, (m0, l0, acc0, k, v, my), None, length=n
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]                   # [B,H,Sq,D]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)               # [B,Sq,H,D]
+
+
+def attention(q, k, v, *, causal: bool = False, seq_axis: Optional[str] = None):
+    """Dispatch: ring attention when a sequence axis is given, else full."""
+    if seq_axis is not None:
+        return ring_attention(q, k, v, seq_axis, causal=causal)
+    return full_attention(q, k, v, causal=causal)

@@ -1,0 +1,163 @@
+"""Vision Transformer (ViT) family — the BASELINE north-star transformer
+config ("ViT-B/16 / ImageNet-1k ... stress allreduce on transformer grads",
+BASELINE.json configs[4]; the reference itself has no transformer, SURVEY
+§2.3).
+
+Same functional contract as :class:`~tpu_dist.nn.resnet.ResNetDef`:
+``init(key) -> (params, state)`` / ``apply(params, state, x, train=,
+axis_name=, seq_axis=)``. ``state`` is empty (no BatchNorm — LayerNorm
+needs no cross-replica sync), so ViT slots into the same Trainer/steps.
+
+``seq_axis`` switches the attention to the sequence-parallel ring variant
+(:func:`tpu_dist.nn.attention.ring_attention`) for long-context training
+over a 2-D DP×SP mesh; patch tokens must then arrive sharded over that axis.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist.nn import attention as attn_lib
+from tpu_dist.nn import initializers as init
+
+
+def _ln_init(dim):
+    return {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))}
+
+
+def _ln_apply(p, x, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _dense_init(key, din, dout):
+    kw, kb = jax.random.split(key)
+    # transformer practice: truncated-normal-ish small init for stability
+    w = jax.random.normal(kw, (din, dout)) * (din ** -0.5)
+    return {"w": w, "b": jnp.zeros((dout,))}
+
+
+def _dense(p, x):
+    return x @ p["w"].astype(x.dtype) + p["b"].astype(x.dtype)
+
+
+@dataclass(frozen=True)
+class ViTDef:
+    image_size: int = 224
+    patch_size: int = 16
+    dim: int = 768
+    depth: int = 12
+    heads: int = 12
+    mlp_ratio: int = 4
+    num_classes: int = 1000
+    pool: str = "mean"  # mean-pool tokens (cls-free keeps seq sharding even)
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    def init(self, key, dtype=jnp.float32):
+        keys = iter(jax.random.split(key, 16 + 8 * self.depth))
+        p: dict = {}
+        patch_dim = self.patch_size * self.patch_size * 3
+        p["patch"] = _dense_init(next(keys), patch_dim, self.dim)
+        p["pos"] = jax.random.normal(next(keys), (self.n_patches, self.dim)) * 0.02
+        blocks = []
+        for _ in range(self.depth):
+            blocks.append(
+                {
+                    "ln1": _ln_init(self.dim),
+                    "qkv": _dense_init(next(keys), self.dim, 3 * self.dim),
+                    "proj": _dense_init(next(keys), self.dim, self.dim),
+                    "ln2": _ln_init(self.dim),
+                    "mlp1": _dense_init(next(keys), self.dim, self.mlp_ratio * self.dim),
+                    "mlp2": _dense_init(next(keys), self.mlp_ratio * self.dim, self.dim),
+                }
+            )
+        p["blocks"] = blocks
+        p["ln_f"] = _ln_init(self.dim)
+        p["head"] = _dense_init(next(keys), self.dim, self.num_classes)
+        if dtype != jnp.float32:
+            p = jax.tree_util.tree_map(lambda t: t.astype(dtype), p)
+        return p, {}
+
+    # -- apply ---------------------------------------------------------------
+
+    def patchify(self, x):
+        """[B, H, W, 3] → [B, N, patch_dim] in row-major patch order."""
+        b, h, w, c = x.shape
+        ph = pw = self.patch_size
+        x = x.reshape(b, h // ph, ph, w // pw, pw, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5)
+        return x.reshape(b, (h // ph) * (w // pw), ph * pw * c)
+
+    def apply(
+        self,
+        params,
+        state,
+        x,
+        *,
+        train: bool = False,
+        axis_name: Optional[str] = None,  # unused (no BN); kept for contract
+        seq_axis: Optional[str] = None,
+        tokens: Optional[jnp.ndarray] = None,
+        pos_offset: int = 0,
+    ):
+        """Forward. Either ``x`` as images [B,H,W,3] (patchified here) or
+        pre-sharded ``tokens`` [B, S_local, patch_dim] for sequence-parallel
+        runs (with ``pos_offset`` the global index of the first local token).
+        """
+        del axis_name
+        if tokens is None:
+            tokens = self.patchify(x)
+        t = _dense(params["patch"], tokens)
+        pos = params["pos"].astype(t.dtype)
+        if seq_axis is not None:
+            idx = jax.lax.axis_index(seq_axis)
+            s_loc = t.shape[1]
+            pos = jax.lax.dynamic_slice_in_dim(pos, idx * s_loc + pos_offset, s_loc)
+        t = t + pos[None]
+
+        h_dim = self.dim // self.heads
+        for blk in params["blocks"]:
+            y = _ln_apply(blk["ln1"], t)
+            qkv = _dense(blk["qkv"], y)
+            b, s, _ = qkv.shape
+            q, k, v = jnp.split(qkv.reshape(b, s, 3, self.heads, h_dim), 3, axis=2)
+            q, k, v = (a.squeeze(2) for a in (q, k, v))
+            o = attn_lib.attention(q, k, v, seq_axis=seq_axis)
+            t = t + _dense(blk["proj"], o.reshape(b, s, self.dim))
+            y = _ln_apply(blk["ln2"], t)
+            y = jax.nn.gelu(_dense(blk["mlp1"], y))
+            t = t + _dense(blk["mlp2"], y)
+
+        t = _ln_apply(params["ln_f"], t)
+        pooled = t.mean(axis=1)
+        if seq_axis is not None:
+            # token mean over the full (sharded) sequence
+            pooled = jax.lax.pmean(pooled, seq_axis)
+        return _dense(params["head"], pooled), state
+
+
+def vit_b16(num_classes: int = 1000, image_size: int = 224) -> ViTDef:
+    """ViT-B/16 (86M params at 1000 classes) — BASELINE configs[4]."""
+    return ViTDef(image_size=image_size, patch_size=16, dim=768, depth=12,
+                  heads=12, num_classes=num_classes)
+
+
+def vit_s16(num_classes: int = 1000, image_size: int = 224) -> ViTDef:
+    return ViTDef(image_size=image_size, patch_size=16, dim=384, depth=12,
+                  heads=6, num_classes=num_classes)
+
+
+def vit_tiny(num_classes: int = 10, image_size: int = 32) -> ViTDef:
+    """CIFAR-sized: patch 4 over 32x32 → 64 tokens; for tests/smokes."""
+    return ViTDef(image_size=image_size, patch_size=4, dim=64, depth=2,
+                  heads=4, num_classes=num_classes)
