@@ -1,0 +1,68 @@
+"""Pallas fused SGD kernel ≡ the plain jnp update (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dist.ops.fused_sgd import fused_sgd_leaf
+from tpu_dist.train.optim import SGD
+
+
+@pytest.mark.parametrize("shape", [(7,), (128,), (33, 5), (3, 3, 4, 16), (1000,)])
+def test_fused_leaf_matches_plain(shape):
+    rng = np.random.default_rng(0)
+    p = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    g = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    b = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    lr, mu, wd = 0.1, 0.9, 1e-4
+
+    new_p, new_b = fused_sgd_leaf(p, g, b, lr, momentum=mu, weight_decay=wd)
+
+    gg = g + wd * p
+    bb = mu * b + gg
+    np.testing.assert_allclose(np.asarray(new_b), np.asarray(bb), rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(new_p), np.asarray(p - lr * bb), rtol=1e-6, atol=1e-7)
+
+
+def test_fused_optimizer_matches_plain_on_tree():
+    rng = np.random.default_rng(1)
+    params = {
+        "a": jnp.asarray(rng.normal(size=(17, 9)), jnp.float32),
+        "nested": {"b": jnp.asarray(rng.normal(size=(130,)), jnp.float32)},
+    }
+    grads = jax.tree_util.tree_map(
+        lambda t: jnp.asarray(rng.normal(size=t.shape), jnp.float32), params
+    )
+
+    plain, fused = SGD(), SGD(fused=True)
+    sp = plain.init(params)
+    sf = fused.init(params)
+    pp, pg = params, sp
+    fp, fg = params, sf
+    for i in range(3):
+        pp, pg = plain.update(grads, pg, pp, 0.05)
+        fp, fg = fused.update(grads, fg, fp, 0.05)
+
+    for a, b in zip(jax.tree_util.tree_leaves(pp), jax.tree_util.tree_leaves(fp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
+
+
+def test_fused_under_jit():
+    p = jnp.ones((64, 64))
+    g = jnp.full((64, 64), 0.5)
+    b = jnp.zeros((64, 64))
+
+    @jax.jit
+    def step(p, g, b, lr):
+        return fused_sgd_leaf(p, g, b, lr)
+
+    new_p, new_b = step(p, g, b, 0.1)
+    expect_b = 0.5 + 1e-4
+    np.testing.assert_allclose(np.asarray(new_b), np.full((64, 64), expect_b), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_p), np.full((64, 64), 1 - 0.1 * expect_b), rtol=1e-6)
+
+
+def test_fused_nesterov_rejected():
+    with pytest.raises(ValueError, match="nesterov"):
+        SGD(fused=True, nesterov=True)

@@ -1,0 +1,60 @@
+"""Profiler hooks and replica-consistency checks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dist.comm import mesh as mesh_lib
+from tpu_dist.metrics.consistency import check_replicated
+from tpu_dist.metrics.profiler import StepTimer, annotate_step, trace
+
+
+def test_step_timer_skips_warmup():
+    t = StepTimer(warmup_steps=2)
+    x = jnp.ones(4)
+    for _ in range(5):
+        x = x * 1.0
+        t.tick()
+    dt = t.finish(blocker=x)
+    assert dt is not None and dt >= 0
+    assert t.steps == 3
+
+
+def test_step_timer_too_few_steps():
+    t = StepTimer(warmup_steps=5)
+    t.tick()
+    assert t.finish() is None
+
+
+def test_annotate_step_contextmanager():
+    with annotate_step(3):
+        _ = jnp.ones(2) + 1
+
+
+def test_trace_writes_profile(tmp_path):
+    with trace(str(tmp_path)):
+        jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    # a plugins/profile dir with at least one capture should exist
+    found = list(tmp_path.rglob("*.xplane.pb"))
+    assert found, list(tmp_path.rglob("*"))
+
+
+def test_check_replicated_passes_on_replicated():
+    mesh = mesh_lib.data_parallel_mesh()
+    tree = jax.device_put({"w": jnp.ones((4, 4))}, mesh_lib.replicated(mesh))
+    check_replicated(tree)
+
+
+def test_check_replicated_detects_divergence():
+    mesh = mesh_lib.data_parallel_mesh()
+    # build a deliberately diverged "replicated" array via per-device put
+    devs = list(mesh.devices.ravel())
+    shards = [jax.device_put(jnp.full((2,), float(i)), d) for i, d in enumerate(devs)]
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    arr = jax.make_array_from_single_device_arrays(
+        (2,), NamedSharding(mesh, P()), shards[:1] * 0 + shards
+    )
+    with pytest.raises(AssertionError, match="replica divergence"):
+        check_replicated({"w": arr}, name="params")

@@ -58,8 +58,10 @@ class TrainConfig:
     eval_every: int = 1
     log_every: int = 20
 
-    # -- bench / smoke ------------------------------------------------------
+    # -- bench / smoke / debug ---------------------------------------------
     steps_per_epoch: Optional[int] = None  # cap steps (smoke tests / benches)
+    debug_replica_check: bool = False  # assert params replicated each epoch
+    profile_dir: Optional[str] = None  # capture an XLA trace of epoch 0
 
     @property
     def coordinator_address(self) -> str:

@@ -1,0 +1,47 @@
+"""Debug-mode cross-replica consistency checks (SURVEY §5 race-detection).
+
+The reference guards against divergence with ``dist.barrier()`` before every
+metric reduction (``distributed.py:95``) — pedagogy, not necessity. Under
+XLA, ordering is dataflow; the failure mode that remains is REPLICA STATE
+DIVERGENCE (e.g. non-deterministic host input, a collective dropped from a
+custom step). This module detects exactly that: assert that nominally
+replicated values really are bitwise-equal across every device.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def check_replicated(tree, name: str = "state", atol: float = 0.0) -> None:
+    """Assert every leaf is identical on all devices holding it.
+
+    Works on replicated (fully-addressable) arrays — fetches each device's
+    shard and compares against device 0's. Raises ``AssertionError`` naming
+    the first diverging leaf. Intended for debug runs / tests, not the hot
+    loop.
+    """
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in leaves_with_paths:
+        if not isinstance(leaf, jax.Array) or not leaf.is_fully_addressable:
+            continue
+        shards = leaf.addressable_shards
+        if len(shards) <= 1:
+            continue
+        ref = np.asarray(shards[0].data)
+        for s in shards[1:]:
+            got = np.asarray(s.data)
+            if ref.shape != got.shape:
+                continue  # sharded (not replicated) leaf — not our concern
+            if atol == 0.0:
+                ok = np.array_equal(ref, got, equal_nan=True)
+            else:
+                ok = np.allclose(ref, got, atol=atol, equal_nan=True)
+            if not ok:
+                key = jax.tree_util.keystr(path)
+                raise AssertionError(
+                    f"replica divergence in {name}{key}: device {shards[0].device} "
+                    f"vs {s.device} (max abs diff "
+                    f"{np.max(np.abs(ref - got)):.3e})"
+                )

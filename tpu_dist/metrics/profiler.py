@@ -1,0 +1,71 @@
+"""Tracing / profiling hooks (SURVEY §5).
+
+The reference's observability is wall-clock ``time.time()`` pairs printed on
+rank 0 (``distributed.py:78,113-115``) — kept, in the Trainer's epoch
+timing. This module adds what the reference lacks:
+
+* :func:`trace` — capture an XLA/TPU profile (TensorBoard-compatible, holds
+  HLO timelines, memory, and ICI collectives) around any code region via
+  ``jax.profiler``.
+* :class:`StepTimer` — cheap steady-state step timing with warmup skip;
+  feeds the seconds/epoch and images/sec/chip numbers BASELINE.json asks
+  for without device-sync overhead in the hot loop.
+* :func:`annotate_step` — names the current step in captured traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str, *, primary_only: bool = True) -> Iterator[None]:
+    """Profile a region to ``logdir`` (view with TensorBoard's profile tab).
+
+    ``primary_only`` keeps the rank-0 discipline: other processes run the
+    region untraced.
+    """
+    if primary_only and jax.process_index() != 0:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate_step(step: int):
+    """Mark a training step in profiles (shows as a named range)."""
+    return jax.profiler.StepTraceAnnotation("train_step", step_num=step)
+
+
+class StepTimer:
+    """Steady-state throughput: skips warmup/compile steps, no per-step
+    device sync (the device queue keeps the TPU busy; only ``finish`` blocks)."""
+
+    def __init__(self, warmup_steps: int = 3):
+        self.warmup_steps = warmup_steps
+        self._seen = 0
+        self._t0: Optional[float] = None
+        self.steps = 0
+
+    def tick(self) -> None:
+        self._seen += 1
+        if self._seen == self.warmup_steps:
+            self._t0 = time.perf_counter()
+        elif self._seen > self.warmup_steps:
+            self.steps += 1
+
+    def finish(self, blocker=None) -> Optional[float]:
+        """Seconds per steady-state step (None if too few steps).
+        ``blocker``: array to ``block_until_ready`` before reading the clock."""
+        if blocker is not None:
+            jax.block_until_ready(blocker)
+        if self._t0 is None or self.steps == 0:
+            return None
+        return (time.perf_counter() - self._t0) / self.steps

@@ -24,16 +24,30 @@ import jax.numpy as jnp
 
 
 class SGD:
-    def __init__(self, momentum: float = 0.9, weight_decay: float = 1e-4, nesterov: bool = False):
+    def __init__(
+        self,
+        momentum: float = 0.9,
+        weight_decay: float = 1e-4,
+        nesterov: bool = False,
+        fused: bool = False,
+    ):
+        """``fused=True`` routes the update through the Pallas fused kernel
+        (``tpu_dist.ops.fused_sgd``, the apex fused-optimizer equivalent);
+        numerically identical to the plain path."""
+        if fused and nesterov:
+            raise ValueError("fused SGD does not implement nesterov")
         self.momentum = momentum
         self.weight_decay = weight_decay
         self.nesterov = nesterov
+        self.fused = fused
 
     def init(self, params):
         return jax.tree_util.tree_map(jnp.zeros_like, params)
 
     def update(self, grads, opt_state, params, lr):
         """Returns ``(new_params, new_opt_state)``. ``lr`` may be traced."""
+        if self.fused:
+            return self._update_fused(grads, opt_state, params, lr)
         mu, wd = self.momentum, self.weight_decay
         tm = jax.tree_util.tree_map
 
@@ -44,6 +58,22 @@ class SGD:
             )
         else:
             new_params = tm(lambda p, b: p - lr * b, params, new_state)
+        return new_params, new_state
+
+    def _update_fused(self, grads, opt_state, params, lr):
+        from tpu_dist.ops.fused_sgd import fused_sgd_leaf  # noqa: PLC0415
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_b = treedef.flatten_up_to(opt_state)
+        out = [
+            fused_sgd_leaf(
+                p, g, b, lr, momentum=self.momentum, weight_decay=self.weight_decay
+            )
+            for p, g, b in zip(flat_p, flat_g, flat_b)
+        ]
+        new_params = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+        new_state = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
         return new_params, new_state
 
 

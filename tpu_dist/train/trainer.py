@@ -190,6 +190,11 @@ class Trainer:
                     f"acc1={m['acc1']:.2f} acc5={m['acc5']:.2f}"
                 )
         jax.block_until_ready(self.state.params)
+        if cfg.debug_replica_check:
+            from tpu_dist.metrics.consistency import check_replicated  # noqa: PLC0415
+
+            check_replicated(self.state.params, "params")
+            check_replicated(self.state.bn_state, "bn_state")
         dt = time.time() - t0
         ips = images_seen / dt if dt > 0 else 0.0
         # reference epoch wall-time print (distributed.py:113-115)
@@ -205,7 +210,13 @@ class Trainer:
         epochs = epochs if epochs is not None else cfg.epochs
         last = {}
         for epoch in range(self.start_epoch, epochs):
-            last = self.train_epoch(epoch)
+            if cfg.profile_dir and epoch == self.start_epoch:
+                from tpu_dist.metrics.profiler import trace  # noqa: PLC0415
+
+                with trace(cfg.profile_dir):
+                    last = self.train_epoch(epoch)
+            else:
+                last = self.train_epoch(epoch)
             if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
                 t1, t5, vloss = validate(
                     self.test_loader, self.state, self.eval_step, epoch=epoch
