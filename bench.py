@@ -1,23 +1,32 @@
-"""Benchmark: ResNet-18 / CIFAR-100 training throughput on TPU.
+"""Benchmark harness: training throughput on TPU, one JSON line on stdout.
 
-Prints ONE JSON line:
+Default (driver contract): ResNet-18 / CIFAR-100 — the reference's headline
+benchmark — printing
 ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
 
-Baseline (BASELINE.md): the reference's best configuration, DDP + apex on
-4×RTX 2080 Ti, 14.5 s/epoch on CIFAR-100's 50,000 train images ≈ 3,448
-img/s aggregate. ``vs_baseline`` is our aggregate images/sec over that
-number (>1.0 = faster than the whole 4-GPU reference rig).
+Baseline (BASELINE.md): the reference's best row, DDP + apex on
+4×RTX 2080 Ti: 14.5 s/epoch over CIFAR-100's 50,000 images ≈ 3,448 img/s
+aggregate. ``vs_baseline`` = our aggregate images/sec ÷ that (>1 beats the
+whole 4-GPU rig).
 
-Runs on whatever devices are visible (1 real TPU chip under the driver;
-any emulated mesh otherwise). Measures the steady-state compiled train
-step, reference hyperparameters (global batch 256, SGD+momentum, SyncBN on,
-bf16 compute — the apex-AMP-equivalent path).
+More configs (BASELINE.json's matrix) via ``--config``:
+
+    python bench.py --config resnet18_cifar100      # default, bf16
+    python bench.py --config resnet18_cifar100_fp32
+    python bench.py --config resnet18_cifar100_ga4  # grad accumulation 4
+    python bench.py --config resnet50_imagenet      # 224x224, bf16
+    python bench.py --config vit_b16_imagenet       # transformer grads
+
+Measures the steady-state compiled train step (warmup excluded), reference
+hyperparameters (SGD+momentum+wd, SyncBN on for the conv nets).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
+from dataclasses import dataclass
 
 import numpy as np
 
@@ -25,66 +34,112 @@ BASELINE_IMG_PER_SEC = 50_000 / 14.5  # DDP+apex, 4x2080Ti (README.md:77)
 CIFAR_TRAIN = 50_000
 
 
-def main() -> None:
+@dataclass(frozen=True)
+class BenchConfig:
+    name: str
+    model: str
+    image_size: int
+    num_classes: int
+    global_batch: int
+    bf16: bool = True
+    grad_accum: int = 1
+    sync_bn: bool = True
+    epoch_images: int = CIFAR_TRAIN  # for sec/epoch derivation
+
+
+CONFIGS = {
+    c.name: c
+    for c in [
+        BenchConfig("resnet18_cifar100", "resnet18", 32, 100, 256),
+        BenchConfig("resnet18_cifar100_fp32", "resnet18", 32, 100, 256, bf16=False),
+        BenchConfig("resnet18_cifar100_ga4", "resnet18", 32, 100, 256, grad_accum=4),
+        BenchConfig(
+            "resnet50_imagenet", "resnet50", 224, 1000, 64,
+            epoch_images=1_281_167,
+        ),
+        BenchConfig(
+            "vit_b16_imagenet", "vit_b16", 224, 1000, 64,
+            sync_bn=False, epoch_images=1_281_167,
+        ),
+    ]
+}
+
+
+def run(cfg: BenchConfig, steps: int, warmup: int) -> dict:
     import jax
     import jax.numpy as jnp
 
     from tpu_dist.comm import mesh as mesh_lib
-    from tpu_dist.nn import resnet18
+    from tpu_dist.nn import resnet18, resnet34, resnet50
+    from tpu_dist.nn.vit import vit_b16
     from tpu_dist.train.optim import SGD
     from tpu_dist.train.state import TrainState
     from tpu_dist.train.step import make_train_step
 
+    models = {
+        "resnet18": resnet18, "resnet34": resnet34, "resnet50": resnet50,
+        "vit_b16": lambda num_classes: vit_b16(num_classes, cfg.image_size),
+    }
     mesh = mesh_lib.data_parallel_mesh()
     n_dev = int(mesh.devices.size)
-    batch = 256
-    if batch % n_dev:
-        batch = n_dev * max(1, batch // n_dev)
+    batch = cfg.global_batch
+    if batch % (n_dev * cfg.grad_accum):
+        batch = n_dev * cfg.grad_accum * max(1, batch // (n_dev * cfg.grad_accum))
 
-    model = resnet18(num_classes=100)
+    model = models[cfg.model](num_classes=cfg.num_classes)
     optimizer = SGD(momentum=0.9, weight_decay=1e-4)
     params, bn_state = model.init(jax.random.PRNGKey(0))
     state = jax.device_put(
         TrainState.create(params, bn_state, optimizer), mesh_lib.replicated(mesh)
     )
     step = make_train_step(
-        model.apply, optimizer, mesh, sync_bn=True, compute_dtype=jnp.bfloat16
+        model.apply,
+        optimizer,
+        mesh,
+        grad_accum_steps=cfg.grad_accum,
+        sync_bn=cfg.sync_bn,
+        compute_dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
     )
 
     rng = np.random.default_rng(0)
     images = mesh_lib.shard_batch(
-        mesh, rng.normal(size=(batch, 32, 32, 3)).astype(np.float32)
+        mesh, rng.normal(size=(batch, cfg.image_size, cfg.image_size, 3)).astype(np.float32)
     )
-    labels = mesh_lib.shard_batch(mesh, rng.integers(0, 100, batch).astype(np.int32))
+    labels = mesh_lib.shard_batch(
+        mesh, rng.integers(0, cfg.num_classes, batch).astype(np.int32)
+    )
 
-    # warmup (compile + cache)
-    for _ in range(10):
+    for _ in range(warmup):
         state, metrics = step(state, images, labels, 0.1)
     jax.block_until_ready(state.params)
 
-    n_steps = 100
     t0 = time.perf_counter()
-    for _ in range(n_steps):
+    for _ in range(steps):
         state, metrics = step(state, images, labels, 0.1)
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
 
-    img_per_sec = batch * n_steps / dt
-    sec_per_epoch = CIFAR_TRAIN / img_per_sec
-    print(
-        json.dumps(
-            {
-                "metric": "resnet18_cifar100_train_throughput",
-                "value": round(img_per_sec, 1),
-                "unit": "images/sec",
-                "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
-                "sec_per_epoch": round(sec_per_epoch, 2),
-                "n_devices": n_dev,
-                "global_batch": batch,
-                "img_per_sec_per_chip": round(img_per_sec / n_dev, 1),
-            }
-        )
-    )
+    img_per_sec = batch * steps / dt
+    return {
+        "metric": f"{cfg.name}_train_throughput",
+        "value": round(img_per_sec, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+        "sec_per_epoch": round(cfg.epoch_images / img_per_sec, 2),
+        "n_devices": n_dev,
+        "global_batch": batch,
+        "img_per_sec_per_chip": round(img_per_sec / n_dev, 1),
+        "step_ms": round(1000 * dt / steps, 2),
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="resnet18_cifar100", choices=sorted(CONFIGS))
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--warmup", type=int, default=10)
+    args = p.parse_args()
+    print(json.dumps(run(CONFIGS[args.config], args.steps, args.warmup)))
 
 
 if __name__ == "__main__":

@@ -22,3 +22,13 @@ def synthetic_cifar(
     images = rng.integers(0, 256, size=(n, image_size, image_size, 3), dtype=np.uint8)
     labels = rng.integers(0, num_classes, size=(n,), dtype=np.int32)
     return images, labels
+
+
+def synthetic_imagenet(
+    n: int = 10_000,
+    num_classes: int = 1000,
+    image_size: int = 224,
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """ImageNet-shaped random data (BASELINE's ResNet-50 / ViT-B configs)."""
+    return synthetic_cifar(n, num_classes, image_size, seed)
