@@ -1,0 +1,83 @@
+"""Worker for the multi-process (multi-host emulation) test.
+
+Launched by tests/test_multihost.py: 2 processes × 4 CPU devices = one
+8-device global mesh across "hosts". Exercises the real multi-host path:
+jax.distributed rendezvous, global mesh construction, per-process data
+sharding, make_array_from_process_local_data, pmean'd training step.
+
+Usage: python tests/_mp_worker.py <coordinator> <num_procs> <proc_id>
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main(coordinator: str, num_procs: int, proc_id: int) -> None:
+    from tpu_dist.comm import mesh as mesh_lib
+
+    mesh_lib.initialize_distributed(coordinator, num_procs, proc_id)
+    assert jax.process_count() == num_procs
+    assert jax.local_device_count() == 4
+
+    from tpu_dist.data import DistributedSampler
+    from tpu_dist.nn import layers as L
+    from tpu_dist.train.optim import SGD
+    from tpu_dist.train.state import TrainState
+    from tpu_dist.train.step import make_train_step
+
+    mesh = mesh_lib.data_parallel_mesh()
+    assert mesh.devices.size == 4 * num_procs
+
+    # per-host disjoint data shards, same global permutation
+    sampler = DistributedSampler(64, num_procs, proc_id, seed=0)
+    sampler.set_epoch(0)
+    idx = sampler.indices()
+
+    class M:
+        def init(self, key):
+            k1, k2 = jax.random.split(key)
+            p = {"conv": L.conv_init(k1, 3, 8, 3), "fc": L.linear_init(k2, 8, 10)}
+            pb, sb = L.bn_init(8)
+            p["bn"] = pb
+            return p, {"bn": sb}
+
+        def apply(self, params, state, x, *, train=False, axis_name=None):
+            y = L.conv_apply(params["conv"], x, 1, 1)
+            y, ns = L.bn_apply(params["bn"], state["bn"], y, train=train, axis_name=axis_name)
+            y = L.relu(y)
+            return L.linear_apply(params["fc"], L.global_avg_pool(y)), {"bn": ns}
+
+    model = M()
+    opt = SGD()
+    params, bn = model.init(jax.random.PRNGKey(0))
+    state = jax.device_put(TrainState.create(params, bn, opt), mesh_lib.replicated(mesh))
+    step = make_train_step(model.apply, opt, mesh, sync_bn=True)
+
+    # deterministic global dataset; each process feeds ITS shard
+    rng = np.random.default_rng(0)
+    all_x = rng.normal(size=(64, 8, 8, 3)).astype(np.float32)
+    all_y = rng.integers(0, 10, 64).astype(np.int32)
+    xs = mesh_lib.shard_batch(mesh, all_x[idx])
+    ys = mesh_lib.shard_batch(mesh, all_y[idx])
+
+    for _ in range(3):
+        state, metrics = step(state, xs, ys, 0.1)
+    loss = float(metrics["loss"])
+
+    # replicated state must be identical across hosts; print for the parent
+    p0 = float(np.asarray(state.params["fc"]["b"].addressable_shards[0].data)[0])
+    print(f"RESULT {proc_id} loss={loss:.6f} p0={p0:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))

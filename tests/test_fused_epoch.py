@@ -1,0 +1,59 @@
+"""Device-resident fused-epoch runner (tpu_dist/train/epoch.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_dist.comm import mesh as mesh_lib
+from tpu_dist.data import synthetic_cifar
+from tpu_dist.train.epoch import make_fused_epoch, put_dataset_on_device
+from tpu_dist.train.optim import SGD
+from tpu_dist.train.state import TrainState
+from tests.helpers import TinyConvNet
+
+
+def _setup(n=256, bpd=4):
+    mesh = mesh_lib.data_parallel_mesh()
+    imgs, lbls = synthetic_cifar(n, 10, image_size=8, seed=0)
+    dx, dy = put_dataset_on_device(mesh, imgs, lbls)
+    model = TinyConvNet()
+    opt = SGD()
+    params, bn = model.init(jax.random.PRNGKey(0))
+    state = jax.device_put(TrainState.create(params, bn, opt), mesh_lib.replicated(mesh))
+    runner = make_fused_epoch(
+        model.apply, opt, mesh, batch_per_device=bpd, compute_dtype=jnp.float32
+    )
+    return mesh, dx, dy, state, runner
+
+
+def test_fused_epoch_runs_all_steps_and_trains():
+    mesh, dx, dy, state, runner = _setup(n=256, bpd=4)
+    # 256 examples / 8 devices = 32 local; bpd 4 -> 8 steps/epoch
+    s1, m1 = runner(state, dx, dy, 0.1, 0)
+    assert int(s1.step) == 8
+    losses = [float(m1["loss"])]
+    s = s1
+    for e in range(1, 6):
+        s, m = runner(s, dx, dy, 0.1, e)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+    assert int(s.step) == 48
+
+
+def test_fused_epoch_deterministic_per_epoch_idx():
+    _, dx, dy, state, runner = _setup()
+    a, ma = runner(state, dx, dy, 0.1, 0)
+    _, dx2, dy2, state2, runner2 = _setup()
+    b, mb = runner2(state2, dx2, dy2, 0.1, 0)
+    np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]), rtol=1e-6)
+    for x, y in zip(jax.tree_util.tree_leaves(a.params), jax.tree_util.tree_leaves(b.params)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-6)
+
+
+def test_fused_epoch_reshuffles_between_epochs():
+    _, dx, dy, state, runner = _setup()
+    s1, m1 = runner(state, dx, dy, 0.0, 0)  # lr=0: params frozen
+    s2, m2 = runner(s1, dx, dy, 0.0, 1)
+    # with lr=0 the only difference between epochs is batch order/augment →
+    # metrics differ unless shuffling is broken
+    assert float(m1["loss"]) != float(m2["loss"])

@@ -1,0 +1,60 @@
+"""Multi-host path: 2 processes × 4 devices, real jax.distributed rendezvous.
+
+The TPU-world equivalent of launching the reference with
+``torch.distributed.launch --nproc_per_node=2`` (SURVEY §2.2 N8): the
+coordinator replaces the TCP store, each process owns its local devices and
+feeds its data shard, and the replicated state must come out identical.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_training_agrees():
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # skip TPU plugin registration
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo_root  # also drops the TPU sitecustomize
+    env.pop("XLA_FLAGS", None)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, coord, "2", str(i)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+        assert p.returncode == 0, out
+
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT"):
+                _, pid, loss, p0 = line.split()
+                results[pid] = (loss, p0)
+    assert set(results) == {"0", "1"}, outs
+    # both hosts see the same reduced loss and identical replicated params
+    assert results["0"] == results["1"], results

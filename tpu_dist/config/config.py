@@ -58,6 +58,10 @@ class TrainConfig:
     eval_every: int = 1
     log_every: int = 20
 
+    # -- TPU fast path -------------------------------------------------------
+    fused_epoch: bool = False      # device-resident data, one jit per epoch
+                                   # (docs in train/epoch.py; small datasets)
+
     # -- bench / smoke / debug ---------------------------------------------
     steps_per_epoch: Optional[int] = None  # cap steps (smoke tests / benches)
     debug_replica_check: bool = False  # assert params replicated each epoch
@@ -83,6 +87,7 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--weight_decay", type=float, default=d.weight_decay)
     p.add_argument("--bf16", action="store_true")
+    p.add_argument("--fused_epoch", action="store_true")
     p.add_argument("--no_sync_bn", dest="sync_bn", action="store_false")
     p.add_argument("--dataset", type=str, default=d.dataset)
     p.add_argument("--data_dir", type=str, default=d.data_dir)

@@ -154,6 +154,17 @@ class Trainer:
             self.model.apply, self.mesh, compute_dtype=compute_dtype
         )
 
+        self._fused_runner = None
+        if cfg.fused_epoch:
+            from tpu_dist.train.epoch import make_fused_epoch, put_dataset_on_device  # noqa: PLC0415
+
+            self._fused_data = put_dataset_on_device(self.mesh, *self.train_data)
+            self._fused_runner = make_fused_epoch(
+                self.model.apply, self.optimizer, self.mesh,
+                batch_per_device=cfg.batch_size // self.n_devices,
+                sync_bn=cfg.sync_bn, compute_dtype=compute_dtype,
+            )
+
         self.start_epoch = 0
         if cfg.resume and cfg.ckpt_dir:
             found = ckpt_lib.latest_checkpoint(cfg.ckpt_dir)
@@ -167,6 +178,8 @@ class Trainer:
     # -- loops ---------------------------------------------------------------
 
     def train_epoch(self, epoch: int) -> dict:
+        if self._fused_runner is not None:
+            return self._train_epoch_fused(epoch)
         cfg = self.cfg
         self.train_sampler.set_epoch(epoch)  # shuffle correctness (tutorials/2:§2)
         lr = self.lr_schedule(epoch)
@@ -204,6 +217,26 @@ class Trainer:
         out = {k: float(v) for k, v in metrics.items()} if metrics else {}
         out.update(epoch_time=dt, images_per_sec=ips)
         return out
+
+    def _train_epoch_fused(self, epoch: int) -> dict:
+        """One jit call for the whole epoch (tpu_dist/train/epoch.py)."""
+        cfg = self.cfg
+        lr = self.lr_schedule(epoch)
+        t0 = time.time()
+        self.state, metrics = self._fused_runner(
+            self.state, *self._fused_data, lr, epoch
+        )
+        m = {k: float(v) for k, v in metrics.items()}  # blocks on completion
+        dt = time.time() - t0
+        n_images = int(self._fused_data[0].shape[0])
+        ips = n_images / dt if dt > 0 else 0.0
+        rank0_print(
+            f"Epoch:[{epoch}/{cfg.epochs}] (fused) lr={lr:.5f} "
+            f"loss={m['loss']:.4f} acc1={m['acc1']:.2f} acc5={m['acc5']:.2f}"
+        )
+        rank0_print(f"Epoch {epoch} done in {dt:.2f}s ({ips:.0f} img/s)")
+        m.update(epoch_time=dt, images_per_sec=ips)
+        return m
 
     def fit(self, epochs: Optional[int] = None) -> dict:
         cfg = self.cfg
