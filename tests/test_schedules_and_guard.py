@@ -1,0 +1,89 @@
+"""Cosine/warmup schedule and the NaN-guard failure detection."""
+
+import numpy as np
+import pytest
+
+from tpu_dist.config import TrainConfig
+from tpu_dist.train.optim import cosine_lr
+from tpu_dist.train.trainer import Trainer, TrainingDivergedError, register_model
+from tests.helpers import tiny_resnet
+
+register_model("tiny_resnet_g", lambda num_classes=10: tiny_resnet(num_classes))
+
+
+def test_cosine_schedule_shape():
+    s = cosine_lr(1.0, total_epochs=100, warmup_epochs=10)
+    assert np.isclose(s(0), 0.1)          # warmup ramp
+    assert np.isclose(s(9), 1.0)
+    assert np.isclose(s(10), 1.0)         # peak at warmup end
+    assert s(55) < s(11)                  # decaying
+    assert np.isclose(s(100), 0.0, atol=1e-8)
+    s2 = cosine_lr(1.0, 100, warmup_epochs=0, min_lr=0.01)
+    assert np.isclose(s2(0), 1.0)
+    assert np.isclose(s2(100), 0.01)
+
+
+def test_trainer_uses_cosine_when_configured():
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet_g", num_classes=10,
+        batch_size=64, epochs=10, lr=1.0, lr_schedule="cosine", warmup_epochs=2,
+        eval_every=0,
+    )
+    t = Trainer(cfg)
+    assert np.isclose(t.lr_schedule(0), 0.5)
+    assert np.isclose(t.lr_schedule(1), 1.0)
+    assert t.lr_schedule(9) < 0.1
+
+
+def test_nan_guard_raises():
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet_g", num_classes=10,
+        batch_size=64, epochs=1, steps_per_epoch=3, log_every=1,
+        lr=1e12, eval_every=0,  # guaranteed blow-up
+    )
+    t = Trainer(cfg)
+    with pytest.raises(TrainingDivergedError, match="non-finite"):
+        t.train_epoch(0)
+
+
+def test_nan_guard_disabled_does_not_raise():
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet_g", num_classes=10,
+        batch_size=64, epochs=1, steps_per_epoch=2, log_every=1,
+        lr=1e12, eval_every=0, nan_guard=False,
+    )
+    out = Trainer(cfg).train_epoch(0)
+    assert not np.isfinite(out["loss"])
+
+
+def test_nan_guard_catches_between_log_steps():
+    # divergence after the last logged step must still raise at epoch end,
+    # BEFORE fit() would checkpoint the poisoned state
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet_g", num_classes=10,
+        batch_size=64, epochs=1, steps_per_epoch=3, log_every=100,
+        lr=1e12, eval_every=0,
+    )
+    with pytest.raises(TrainingDivergedError, match="end of epoch"):
+        Trainer(cfg).train_epoch(0)
+
+
+def test_nan_guard_covers_fused_epoch():
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet_g", num_classes=10,
+        batch_size=512, epochs=1, lr=1e12, eval_every=0, fused_epoch=True,
+        synthetic_n=1024,  # 2 fused steps: keep the epoch-compile small
+    )
+    with pytest.raises(TrainingDivergedError, match="fused epoch"):
+        Trainer(cfg).train_epoch(0)
+
+
+def test_no_nan_guard_cli_flag():
+    import argparse
+
+    from tpu_dist.config import add_reference_flags, config_from_args
+
+    p = add_reference_flags(argparse.ArgumentParser())
+    cfg = config_from_args(p.parse_args(["--no_nan_guard"]))
+    assert cfg.nan_guard is False
+    assert config_from_args(p.parse_args([])).nan_guard is True

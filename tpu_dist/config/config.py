@@ -30,8 +30,10 @@ class TrainConfig:
     # -- optimizer / schedule (hard-coded in the reference) -----------------
     momentum: float = 0.9          # distributed.py:63
     weight_decay: float = 1e-4     # distributed.py:63
+    lr_schedule: str = "multistep" # multistep (reference) | cosine
     lr_milestones: Tuple[int, ...] = (60, 120, 160)  # distributed.py:64
     lr_gamma: float = 0.2          # distributed.py:64
+    warmup_epochs: int = 0         # cosine schedule only
 
     # -- TPU-native switches (replace whole reference scripts) --------------
     bf16: bool = False             # apex AMP path (distributed_apex.py) → bf16 policy
@@ -41,6 +43,7 @@ class TrainConfig:
     # -- data ---------------------------------------------------------------
     dataset: str = "cifar100"      # cifar100 | synthetic
     data_dir: str = "./data"
+    synthetic_n: int = 50_000      # synthetic train-set size (tests/smokes)
     num_workers: int = 4           # loader prefetch depth (passed to DataLoader)
 
     # -- model --------------------------------------------------------------
@@ -66,6 +69,7 @@ class TrainConfig:
     steps_per_epoch: Optional[int] = None  # cap steps (smoke tests / benches)
     debug_replica_check: bool = False  # assert params replicated each epoch
     profile_dir: Optional[str] = None  # capture an XLA trace of epoch 0
+    nan_guard: bool = True         # raise TrainingDivergedError on NaN loss
 
     @property
     def coordinator_address(self) -> str:
@@ -86,9 +90,12 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--grad_accu_steps", type=int, default=d.grad_accu_steps)
     p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--weight_decay", type=float, default=d.weight_decay)
+    p.add_argument("--lr_schedule", choices=("multistep", "cosine"), default=d.lr_schedule)
+    p.add_argument("--warmup_epochs", type=int, default=d.warmup_epochs)
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--fused_epoch", action="store_true")
     p.add_argument("--no_sync_bn", dest="sync_bn", action="store_false")
+    p.add_argument("--no_nan_guard", dest="nan_guard", action="store_false")
     p.add_argument("--dataset", type=str, default=d.dataset)
     p.add_argument("--data_dir", type=str, default=d.data_dir)
     p.add_argument("--model", type=str, default=d.model)

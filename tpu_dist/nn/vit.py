@@ -22,7 +22,6 @@ import jax
 import jax.numpy as jnp
 
 from tpu_dist.nn import attention as attn_lib
-from tpu_dist.nn import initializers as init
 
 
 def _ln_init(dim):

@@ -87,3 +87,20 @@ def multistep_lr(base_lr: float, milestones: Sequence[int] = (60, 120, 160), gam
         return float(base_lr * (gamma ** k))
 
     return schedule
+
+
+def cosine_lr(base_lr: float, total_epochs: int, warmup_epochs: int = 0, min_lr: float = 0.0):
+    """Linear warmup then cosine decay to ``min_lr`` — the standard
+    transformer/ViT schedule (no reference counterpart; the reference only
+    ships MultiStepLR, ``distributed.py:64``). Epoch-granular like the
+    reference's scheduler."""
+    import math
+
+    def schedule(epoch: int) -> float:
+        if warmup_epochs > 0 and epoch < warmup_epochs:
+            return float(base_lr * (epoch + 1) / warmup_epochs)
+        t = (epoch - warmup_epochs) / max(1, total_epochs - warmup_epochs)
+        t = min(max(t, 0.0), 1.0)
+        return float(min_lr + 0.5 * (base_lr - min_lr) * (1.0 + math.cos(math.pi * t)))
+
+    return schedule

@@ -30,8 +30,7 @@ call runs the whole step on every chip with static shapes and no host sync.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

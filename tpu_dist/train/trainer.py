@@ -30,9 +30,8 @@ import time
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-
+import jax.numpy as jnp
 from tpu_dist import ckpt as ckpt_lib
 from tpu_dist.comm import mesh as mesh_lib
 from tpu_dist.config import TrainConfig
@@ -41,16 +40,21 @@ from tpu_dist.data import (
     DistributedSampler,
     load_cifar100,
     synthetic_cifar,
-    transforms,
 )
 from tpu_dist.evaluation import validate
 from tpu_dist.metrics import AverageMeter, rank0_print
 from tpu_dist.nn import resnet18, resnet34, resnet50
-from tpu_dist.train.optim import SGD, multistep_lr
+from tpu_dist.train.optim import SGD, cosine_lr, multistep_lr
 from tpu_dist.train.state import TrainState
 from tpu_dist.train.step import make_eval_step, make_train_step
 
 _MODELS = {"resnet18": resnet18, "resnet34": resnet34, "resnet50": resnet50}
+
+
+class TrainingDivergedError(RuntimeError):
+    """Raised by the NaN guard — the failure-detection subsystem the
+    reference lacks entirely (SURVEY §5: no failure detection/recovery).
+    Catch it and restore from ``ckpt_dir`` to implement auto-recovery."""
 
 
 def register_model(name: str, factory) -> None:
@@ -89,8 +93,10 @@ class Trainer:
 
         # -- data ------------------------------------------------------------
         if cfg.dataset == "synthetic":
-            self.train_data = synthetic_cifar(50_000, cfg.num_classes, seed=1)
-            self.test_data = synthetic_cifar(10_000, cfg.num_classes, seed=2)
+            self.train_data = synthetic_cifar(cfg.synthetic_n, cfg.num_classes, seed=1)
+            self.test_data = synthetic_cifar(
+                max(cfg.synthetic_n // 5, self.n_devices), cfg.num_classes, seed=2
+            )
         elif cfg.dataset == "cifar100":
             self.train_data = load_cifar100(cfg.data_dir, train=True)
             self.test_data = load_cifar100(cfg.data_dir, train=False)
@@ -141,7 +147,10 @@ class Trainer:
         state = TrainState.create(params, bn_state, self.optimizer)
         # replicate across the mesh (DDP's init-time param broadcast)
         self.state = jax.device_put(state, mesh_lib.replicated(self.mesh))
-        self.lr_schedule = multistep_lr(cfg.lr, cfg.lr_milestones, cfg.lr_gamma)
+        if cfg.lr_schedule == "cosine":
+            self.lr_schedule = cosine_lr(cfg.lr, cfg.epochs, cfg.warmup_epochs)
+        else:
+            self.lr_schedule = multistep_lr(cfg.lr, cfg.lr_milestones, cfg.lr_gamma)
 
         compute_dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
         self.train_step = make_train_step(
@@ -195,6 +204,11 @@ class Trainer:
             images_seen += cfg.batch_size
             if step % cfg.log_every == 0:
                 m = {k: float(v) for k, v in metrics.items()}  # device sync
+                if cfg.nan_guard and not np.isfinite(m["loss"]):
+                    raise TrainingDivergedError(
+                        f"non-finite loss {m['loss']} at epoch {epoch} step {step} "
+                        f"(lr={lr}); restore from ckpt_dir to recover"
+                    )
                 losses.update(m["loss"], cfg.batch_size)
                 # reference per-step line (distributed.py:104-111)
                 rank0_print(
@@ -203,6 +217,15 @@ class Trainer:
                     f"acc1={m['acc1']:.2f} acc5={m['acc5']:.2f}"
                 )
         jax.block_until_ready(self.state.params)
+        # end-of-epoch guard: catches divergence between logged steps BEFORE
+        # fit() writes a checkpoint of the poisoned state
+        if cfg.nan_guard and metrics:
+            final_loss = float(metrics["loss"])
+            if not np.isfinite(final_loss):
+                raise TrainingDivergedError(
+                    f"non-finite loss {final_loss} at end of epoch {epoch} "
+                    f"(lr={lr}); restore from ckpt_dir to recover"
+                )
         if cfg.debug_replica_check:
             from tpu_dist.metrics.consistency import check_replicated  # noqa: PLC0415
 
@@ -227,6 +250,11 @@ class Trainer:
             self.state, *self._fused_data, lr, epoch
         )
         m = {k: float(v) for k, v in metrics.items()}  # blocks on completion
+        if cfg.nan_guard and not np.isfinite(m["loss"]):
+            raise TrainingDivergedError(
+                f"non-finite loss {m['loss']} in fused epoch {epoch} (lr={lr}); "
+                f"restore from ckpt_dir to recover"
+            )
         dt = time.time() - t0
         n_images = int(self._fused_data[0].shape[0])
         ips = n_images / dt if dt > 0 else 0.0
