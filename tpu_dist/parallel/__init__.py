@@ -1,0 +1,8 @@
+from tpu_dist.parallel.tensor import (  # noqa: F401
+    column_parallel_dense,
+    row_parallel_dense,
+    shard_columns,
+    shard_rows,
+)
+from tpu_dist.parallel.expert import MoE  # noqa: F401
+from tpu_dist.parallel.pipeline import pipeline_apply  # noqa: F401

@@ -1,0 +1,114 @@
+"""Expert parallelism: top-1 (Switch-style) Mixture-of-Experts over a mesh
+axis, with capacity-based dispatch/combine through ``lax.all_to_all``.
+
+Beyond the reference's scope (SURVEY §2.3: no EP anywhere), built so the
+``expert`` mesh axis is exercised for real:
+
+* every device holds ``E/n`` experts' weights (expert-sharded params),
+* tokens are routed top-1 with a capacity limit ``C`` per expert,
+* dispatch: one-hot einsum packs tokens into ``[E, C, d]`` slots, then ONE
+  ``all_to_all`` over the axis moves each expert's slots to its owner,
+* experts run their FFN on their ``[n_local_tokens... , C, d]`` slab,
+* combine: the reverse ``all_to_all`` + weighted einsum restores token
+  order, scaled by the router gate.
+
+Tokens that overflow an expert's capacity are dropped (standard Switch
+behavior) — their output is 0 and the residual connection carries them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class MoE:
+    """Top-1 MoE FFN. ``n_experts`` must be a multiple of the axis size.
+
+    ``init(key, d_model, d_ff)`` → params with leading expert dim E.
+    Shard params over the axis with ``P('expert')`` on that dim (or slice
+    manually per device inside shard_map via ``params_local``).
+    """
+
+    n_experts: int
+    capacity_factor: float = 1.25
+
+    def init(self, key, d_model: int, d_ff: int):
+        k1, k2, k3 = jax.random.split(key, 3)
+        E = self.n_experts
+        s1 = d_model ** -0.5
+        s2 = d_ff ** -0.5
+        return {
+            "router": jax.random.normal(k1, (d_model, E)) * s1,
+            "w_in": jax.random.normal(k2, (E, d_model, d_ff)) * s1,
+            "w_out": jax.random.normal(k3, (E, d_ff, d_model)) * s2,
+        }
+
+    # -- dense reference (single device, no sharding) -----------------------
+
+    def apply_dense(self, params, x):
+        """[T, d] → [T, d]; ground truth for the EP path."""
+        T, d = x.shape
+        E = self.n_experts
+        C = self._capacity(T)
+        gates, idx, disp = self._route(params, x, C)
+        slots = jnp.einsum("tec,td->ecd", disp, x)            # [E, C, d]
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", slots, params["w_in"]))
+        out = jnp.einsum("ecf,efd->ecd", h, params["w_out"])  # [E, C, d]
+        return jnp.einsum("tec,ecd->td", disp, out) * gates[:, None]
+
+    # -- expert-parallel (inside shard_map over `axis`) ---------------------
+
+    def apply_ep(self, params_repl_router, w_in_local, w_out_local, x, axis: str):
+        """Expert-parallel forward for THIS device's token shard ``x``
+        [T_loc, d]. ``w_in_local``/``w_out_local``: [E/n, d, f] local expert
+        slabs; router weights replicated.
+
+        Every device dispatches its tokens into per-expert capacity slots,
+        one ``all_to_all`` exchanges slots so each device receives all
+        devices' slots for ITS experts, the local experts run, and the
+        reverse ``all_to_all`` + combine restores token order.
+        """
+        n = lax.axis_size(axis)
+        T_loc, d = x.shape
+        E = self.n_experts
+        e_loc = E // n
+        C = self._capacity(T_loc)
+
+        gates, idx, disp = self._route({"router": params_repl_router}, x, C)
+        slots = jnp.einsum("tec,td->ecd", disp, x)             # [E, C, d]
+        # group by owner device: [n, e_loc, C, d] → all_to_all over axis
+        slots = slots.reshape(n, e_loc, C, d)
+        recv = lax.all_to_all(slots, axis, split_axis=0, concat_axis=0, tiled=False)
+        # recv: [n, e_loc, C, d] — slot blocks from every peer for MY experts
+        h = jax.nn.gelu(jnp.einsum("necd,edf->necf", recv, w_in_local))
+        out = jnp.einsum("necf,efd->necd", h, w_out_local)
+        # send results back to the token owners
+        back = lax.all_to_all(out, axis, split_axis=0, concat_axis=0, tiled=False)
+        back = back.reshape(E, C, d)
+        return jnp.einsum("tec,ecd->td", disp, back) * gates[:, None]
+
+    # -- shared routing ------------------------------------------------------
+
+    def _capacity(self, T: int) -> int:
+        return max(1, int(self.capacity_factor * T / self.n_experts))
+
+    def _route(self, params, x, C: int):
+        """Top-1 routing with capacity: returns (gates [T], idx [T],
+        dispatch one-hot [T, E, C])."""
+        logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        idx = jnp.argmax(probs, axis=-1)                      # [T]
+        gates = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(idx, self.n_experts, dtype=jnp.int32)  # [T, E]
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1         # slot per token
+        keep = (pos < C) & (pos >= 0)
+        # slot of the routed expert (-1 when dropped); one_hot(-1) is all-zero
+        slot = jnp.where(keep, pos, -1).max(-1)
+        pos_oh = jax.nn.one_hot(slot, C, dtype=x.dtype)       # [T, C]
+        disp = onehot.astype(x.dtype)[:, :, None] * pos_oh[:, None, :]
+        return gates.astype(x.dtype), idx, disp

@@ -1,0 +1,74 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution over a
+mesh axis, with ``ppermute`` stage-to-stage transfers.
+
+Beyond the reference's scope (SURVEY §2.3: no PP anywhere). The classic
+SPMD formulation: every device holds ONE stage's parameters; microbatches
+flow through the pipeline as a ``lax.scan`` over n_micro + n_stages - 1
+ticks. At each tick a device runs its stage on the activation it holds and
+passes the result to the next stage via ``lax.ppermute`` (nearest-neighbor
+ICI). Bubble fraction is the usual (S-1)/(M+S-1).
+
+Forward-only building block (inference / activation serving); training
+composes it under ``jax.grad`` — XLA differentiates through ``ppermute``
+(reverse permutation), so a pipelined loss is differentiable as-is.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params_local,
+    x_micro,
+    axis: str,
+    n_stages: int,
+):
+    """Run microbatches through the stage pipeline.
+
+    Inside ``shard_map`` over ``axis`` (size == n_stages):
+
+    * ``stage_fn(params, h) -> h`` — one stage's computation (same shape in
+      and out, the homogeneous-stage case),
+    * ``stage_params_local`` — THIS device's stage parameters,
+    * ``x_micro`` — [M, B_micro, ...] microbatches, replicated; stage 0
+      feeds them in, the last stage's outputs come back replicated via a
+      final broadcast.
+
+    Returns [M, B_micro, ...] outputs (valid on every device).
+    """
+    M = x_micro.shape[0]
+    my = lax.axis_index(axis)
+    n = n_stages
+    total = M + n - 1
+
+    def tick(carry, t):
+        h, outs = carry
+        # stage 0 ingests microbatch t (when in range), others use incoming h
+        feed = jnp.where(t < M, t, 0)
+        h = jnp.where(my == 0, x_micro[feed], h)
+        y = stage_fn(stage_params_local, h)
+        # last stage records its result into the output slot for micro t-n+1
+        out_idx = t - (n - 1)
+        write = (my == n - 1) & (out_idx >= 0)
+        outs = lax.cond(
+            write,
+            lambda o: lax.dynamic_update_index_in_dim(o, y, jnp.maximum(out_idx, 0), 0),
+            lambda o: o,
+            outs,
+        )
+        # shift activations to the next stage
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        h = lax.ppermute(y, axis, perm)
+        return (h, outs), None
+
+    h0 = jnp.zeros_like(x_micro[0])
+    outs0 = jnp.zeros_like(x_micro)
+    (_, outs), _ = lax.scan(tick, (h0, outs0), jnp.arange(total))
+    # outs is only valid on the last stage; broadcast it to every device
+    outs = lax.psum(jnp.where(my == n - 1, outs, jnp.zeros_like(outs)), axis)
+    return outs

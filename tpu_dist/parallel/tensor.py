@@ -1,0 +1,63 @@
+"""Tensor (model) parallelism primitives over a named mesh axis.
+
+Megatron-style column/row parallel linear layers, TPU-idiomatic: the weight
+lives SHARDED on the ``model`` axis (each device holds a slice), activations
+flow through with at most one ``psum`` per pair. Out of the reference's
+scope (SURVEY §2.3: model parallelism is theory-only there, ``tutorials/
+0:3-6``) but first-class here so the mesh design doesn't preclude it.
+
+Pair them the standard way for an MLP / attention projection:
+
+    h = column_parallel_dense(x, W1_local, axis)   # [.., d_ff/n] local
+    h = activation(h)                              # elementwise, stays local
+    y = row_parallel_dense(h, W2_local, axis)      # psum -> replicated
+
+so the wide hidden dimension is never materialized on one chip and the
+only communication is the single output-side ``psum``.
+
+All functions run inside ``shard_map`` with the weight's shard dim mapped
+to ``axis``.
+"""
+
+from __future__ import annotations
+
+from jax import lax
+
+
+def shard_columns(w, axis_size: int, index: int):
+    """Host-side helper: slice [din, dout] → this device's [din, dout/n]."""
+    step = w.shape[1] // axis_size
+    return w[:, index * step : (index + 1) * step]
+
+
+def shard_rows(w, axis_size: int, index: int):
+    """Host-side helper: slice [din, dout] → this device's [din/n, dout]."""
+    step = w.shape[0] // axis_size
+    return w[index * step : (index + 1) * step]
+
+
+def column_parallel_dense(x, w_local, axis: str, b_local=None):
+    """``x @ W`` with W column-sharded over ``axis``.
+
+    Input ``x`` replicated over ``axis``; output is the LOCAL slice of the
+    activations (sharded hidden dim). No communication.
+    """
+    del axis  # no collective needed; kept for signature symmetry
+    y = x @ w_local.astype(x.dtype)
+    if b_local is not None:
+        y = y + b_local.astype(x.dtype)
+    return y
+
+
+def row_parallel_dense(x_local, w_local, axis: str, b=None):
+    """``x @ W`` with W row-sharded over ``axis`` and ``x`` carrying the
+    matching sharded feature dim. One ``psum`` makes the output replicated.
+
+    Bias (replicated) is added AFTER the psum so it isn't multiplied by the
+    axis size.
+    """
+    partial = x_local @ w_local.astype(x_local.dtype)
+    y = lax.psum(partial, axis)
+    if b is not None:
+        y = y + b.astype(y.dtype)
+    return y
