@@ -1,0 +1,65 @@
+"""Launcher CLI, checkpoint pruning, JSONL metrics history."""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_dist.ckpt import latest_checkpoint, save
+from tpu_dist.cli.launch import main as launch_main
+from tpu_dist.metrics.history import MetricsHistory
+from tpu_dist.train.optim import SGD
+from tpu_dist.train.state import TrainState
+
+
+def test_launcher_spawns_and_propagates_success(tmp_path):
+    marker = tmp_path / "out"
+    rc = launch_main([
+        "--nproc", "2", "--devices_per_proc", "1", "--",
+        sys.executable, "-c",
+        (
+            "import sys, pathlib\n"
+            "args = dict(zip(sys.argv[1::2], sys.argv[2::2]))\n"
+            f"pathlib.Path(r'{marker}' + args['--process_id']).write_text(args['--num_processes'])\n"
+        ),
+    ])
+    assert rc == 0
+    assert (tmp_path / "out0").read_text() == "2"
+    assert (tmp_path / "out1").read_text() == "2"
+
+
+def test_launcher_propagates_failure():
+    rc = launch_main([
+        "--nproc", "2", "--devices_per_proc", "1", "--",
+        sys.executable, "-c", "import sys; sys.exit(int(sys.argv[-1][-1]) and 3)",
+    ])
+    assert rc == 3
+
+
+def test_ckpt_keep_last(tmp_path):
+    st = TrainState.create({"w": jnp.ones(3)}, {}, SGD())
+    for e in range(5):
+        save(str(tmp_path), st, e, keep_last=2)
+    kept = sorted(f for f in os.listdir(tmp_path) if f.endswith(".npz"))
+    assert kept == ["ckpt_3.npz", "ckpt_4.npz"]
+    assert latest_checkpoint(str(tmp_path))[1] == 4
+
+
+def test_metrics_history_jsonl(tmp_path):
+    path = str(tmp_path / "log" / "metrics.jsonl")
+    h = MetricsHistory(path)
+    h.log("train_epoch", epoch=0, loss=np.float32(1.5), images_per_sec=100.0)
+    h.log("eval", epoch=0, top1=12.5)
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 2
+    assert lines[0]["kind"] == "train_epoch" and lines[0]["loss"] == 1.5
+    assert lines[1]["top1"] == 12.5
+    assert all("ts" in l for l in lines)
+
+
+def test_metrics_history_disabled():
+    h = MetricsHistory(None)
+    h.log("train_epoch", loss=1.0)  # must be a no-op, no error

@@ -48,8 +48,12 @@ def _unflatten(template, flat: dict):
     return jax.tree_util.tree_unflatten(treedef, [l for l in leaves])
 
 
-def save(ckpt_dir: str, state: TrainState, epoch: int) -> Optional[str]:
-    """Write ``ckpt_{epoch}.npz``; no-op off process 0 (rank-0 guard)."""
+def save(
+    ckpt_dir: str, state: TrainState, epoch: int, keep_last: Optional[int] = None
+) -> Optional[str]:
+    """Write ``ckpt_{epoch}.npz``; no-op off process 0 (rank-0 guard).
+
+    ``keep_last``: prune to the N newest checkpoints after writing."""
     if jax.process_index() != 0:
         return None
     os.makedirs(ckpt_dir, exist_ok=True)
@@ -63,6 +67,17 @@ def save(ckpt_dir: str, state: TrainState, epoch: int) -> Optional[str]:
     with open(tmp, "wb") as f:
         np.savez(f, **flat)
     os.replace(tmp, path)  # atomic: a ckpt file is either absent or complete
+    if keep_last is not None and keep_last > 0:
+        epochs = sorted(
+            int(m.group(1))
+            for m in (_CKPT_RE.search(n) for n in os.listdir(ckpt_dir))
+            if m
+        )
+        for e in epochs[:-keep_last]:
+            try:
+                os.remove(os.path.join(ckpt_dir, f"ckpt_{e}.npz"))
+            except OSError:
+                pass
     return path
 
 

@@ -1,0 +1,102 @@
+"""Local multi-process launcher — ``torch.distributed.launch`` / ``torchrun``
+equivalent (SURVEY §2.2 N8).
+
+On real TPU pods you normally run ONE process per host and the TPU runtime
+does slice discovery, so this launcher exists for two cases the reference's
+launchers cover:
+
+* spinning up a multi-process run on one machine (CPU emulation of
+  multi-host — each process gets its own device set via
+  ``--xla_force_host_platform_device_count``),
+* explicitly-coordinated multi-host setups where you want rank/env control
+  (`--node_rank`-style splits).
+
+Usage::
+
+    python -m tpu_dist.cli.launch --nproc 2 --devices_per_proc 4 -- \
+        python -m tpu_dist.cli.train --dataset synthetic --epochs 1
+
+Injects ``--num_processes/--process_id/--ip/--port`` into the child command
+line (the reference injects ``--local_rank``, ``distributed.py:18-25``) and
+propagates failures: first child to die non-zero kills the rest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+from typing import List, Optional, Sequence
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(description="tpu_dist multi-process launcher")
+    p.add_argument("--nproc", type=int, required=True, help="processes to spawn")
+    p.add_argument("--ip", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0, help="0 = pick a free port")
+    p.add_argument(
+        "--devices_per_proc", type=int, default=0,
+        help=">0: give each process N emulated CPU devices (testing mode)",
+    )
+    p.add_argument("cmd", nargs=argparse.REMAINDER, help="-- command to run")
+    args = p.parse_args(argv)
+
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("missing command (after --)")
+    port = args.port or _free_port()
+
+    procs: List[subprocess.Popen] = []
+    try:
+        for rank in range(args.nproc):
+            env = dict(os.environ)
+            if args.devices_per_proc > 0:
+                env["PALLAS_AXON_POOL_IPS"] = ""  # CPU testing mode
+                env["JAX_PLATFORMS"] = "cpu"
+                env["XLA_FLAGS"] = (
+                    env.get("XLA_FLAGS", "")
+                    + f" --xla_force_host_platform_device_count={args.devices_per_proc}"
+                ).strip()
+            child = cmd + [
+                "--num_processes", str(args.nproc),
+                "--process_id", str(rank),
+                "--ip", args.ip,
+                "--port", str(port),
+            ]
+            procs.append(subprocess.Popen(child, env=env))
+
+        rc = 0
+        while procs:
+            for pr in list(procs):
+                ret = pr.poll()
+                if ret is None:
+                    continue
+                procs.remove(pr)
+                if ret != 0 and rc == 0:
+                    rc = ret
+                    for other in procs:  # fail fast like torchrun
+                        other.send_signal(signal.SIGTERM)
+            if procs:
+                try:
+                    procs[0].wait(timeout=1)
+                except subprocess.TimeoutExpired:
+                    pass
+        return rc
+    finally:
+        for pr in procs:
+            pr.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

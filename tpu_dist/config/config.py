@@ -57,9 +57,11 @@ class TrainConfig:
     # -- checkpoint / eval cadence -----------------------------------------
     ckpt_dir: Optional[str] = None
     save_every: int = 15           # dead utils/config.py:7 'save_epoch', made real
+    keep_last_ckpts: Optional[int] = None  # prune to N newest (None = keep all)
     resume: bool = False
     eval_every: int = 1
     log_every: int = 20
+    log_file: Optional[str] = None # JSONL metrics history (rank 0)
 
     # -- TPU fast path -------------------------------------------------------
     fused_epoch: bool = False      # device-resident data, one jit per epoch
@@ -103,7 +105,9 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--num_processes", type=int, default=None)
     p.add_argument("--process_id", type=int, default=None)
     p.add_argument("--ckpt_dir", type=str, default=None)
+    p.add_argument("--keep_last_ckpts", type=int, default=None)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--log_file", type=str, default=None)
     p.add_argument("--steps_per_epoch", type=int, default=None)
     p.add_argument("--log_every", type=int, default=d.log_every)
     # accepted for command-line parity with torch.distributed.launch; unused

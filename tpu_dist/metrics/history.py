@@ -1,0 +1,30 @@
+"""JSONL metrics history — file-based observability the reference reserves
+but never builds (``.gitignore:3`` ignores ``/log``; tensorboard knob dead
+in ``utils/config.py:8``). One JSON object per line, append-only, rank-0
+only; consumable by pandas/jq/tensorboard-importers alike.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+import jax
+
+
+class MetricsHistory:
+    def __init__(self, path: Optional[str]):
+        """``path=None`` disables (and any non-primary process is a no-op)."""
+        self.path = path if (path and jax.process_index() == 0) else None
+        if self.path:
+            os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+
+    def log(self, kind: str, **fields) -> None:
+        if not self.path:
+            return
+        rec = {"ts": round(time.time(), 3), "kind": kind}
+        rec.update({k: (float(v) if hasattr(v, "item") else v) for k, v in fields.items()})
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")

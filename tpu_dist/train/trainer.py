@@ -269,6 +269,9 @@ class Trainer:
     def fit(self, epochs: Optional[int] = None) -> dict:
         cfg = self.cfg
         epochs = epochs if epochs is not None else cfg.epochs
+        from tpu_dist.metrics.history import MetricsHistory  # noqa: PLC0415
+
+        history = MetricsHistory(cfg.log_file)
         last = {}
         for epoch in range(self.start_epoch, epochs):
             if cfg.profile_dir and epoch == self.start_epoch:
@@ -278,13 +281,15 @@ class Trainer:
                     last = self.train_epoch(epoch)
             else:
                 last = self.train_epoch(epoch)
+            history.log("train_epoch", epoch=epoch, **last)
             if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
                 t1, t5, vloss = validate(
                     self.test_loader, self.state, self.eval_step, epoch=epoch
                 )
                 last.update(val_top1=t1, val_top5=t5, val_loss=vloss)
+                history.log("eval", epoch=epoch, top1=t1, top5=t5, loss=vloss)
             if cfg.ckpt_dir and (epoch + 1) % cfg.save_every == 0:
-                ckpt_lib.save(cfg.ckpt_dir, self.state, epoch)
+                ckpt_lib.save(cfg.ckpt_dir, self.state, epoch, cfg.keep_last_ckpts)
         if cfg.ckpt_dir:
-            ckpt_lib.save(cfg.ckpt_dir, self.state, epochs - 1)
+            ckpt_lib.save(cfg.ckpt_dir, self.state, epochs - 1, cfg.keep_last_ckpts)
         return last
