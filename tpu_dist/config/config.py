@@ -66,6 +66,8 @@ class TrainConfig:
     # -- TPU fast path -------------------------------------------------------
     fused_epoch: bool = False      # device-resident data, one jit per epoch
                                    # (docs in train/epoch.py; small datasets)
+    shard_weight_update: bool = False  # ZeRO-1 weight-update sharding
+                                       # (arXiv:2004.13336; train/step.py)
 
     # -- bench / smoke / debug ---------------------------------------------
     steps_per_epoch: Optional[int] = None  # cap steps (smoke tests / benches)
@@ -96,6 +98,7 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--warmup_epochs", type=int, default=d.warmup_epochs)
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--fused_epoch", action="store_true")
+    p.add_argument("--shard_weight_update", "--zero1", action="store_true")
     p.add_argument("--no_sync_bn", dest="sync_bn", action="store_false")
     p.add_argument("--no_nan_guard", dest="nan_guard", action="store_false")
     p.add_argument("--dataset", type=str, default=d.dataset)

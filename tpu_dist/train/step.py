@@ -53,6 +53,7 @@ def make_train_step(
     compute_dtype=jnp.float32,
     axis: str = mesh_lib.DATA_AXIS,
     donate: bool = True,
+    shard_weight_update: bool = False,
 ):
     """Build ``step(state, images, labels, lr) -> (state, metrics)``.
 
@@ -61,9 +62,20 @@ def make_train_step(
     replica-averaged scalars: loss, top-1/top-5 accuracy (the reference's
     per-step ``reduce_mean(loss)`` + ``accuracy`` line,
     ``distributed.py:104-111``).
+
+    ``shard_weight_update=True`` enables cross-replica weight-update
+    sharding (Xu et al. 2020, arXiv:2004.13336 — ZeRO-1 on TPU): the grad
+    allreduce becomes reduce-scatter, each replica updates only its 1/n
+    shard of the (flattened) parameters with a SHARDED momentum state, and
+    an all-gather rebuilds the replicated params. Same numerics, 1/n the
+    optimizer-state memory, and 2x less collective traffic than
+    allreduce+full-update at large scale. The optimizer state becomes one
+    flat f32 array per replica — build it with
+    :func:`init_sharded_opt_state`.
     """
     bn_axis = axis if sync_bn else None
     K = int(grad_accum_steps)
+    n_axis = int(mesh.shape[axis])
 
     def loss_fn(params, bn_state, images, labels):
         x = images.astype(compute_dtype)
@@ -101,15 +113,20 @@ def make_train_step(
     def step_local(state: TrainState, images, labels, lr):
         loss, grads, new_bn, logits = local_grads(state.params, state.bn_state, images, labels)
 
-        # THE data-parallel step: average grads over the mesh (DDP engine).
-        grads = lax.pmean(grads, axis)
         if not sync_bn:
             # Local-BN replicas hold diverged running stats; average them so
             # the replicated state stays consistent (torch instead keeps
             # per-rank stats and saves rank 0's — documented deviation).
             new_bn = lax.pmean(new_bn, axis)
 
-        new_params, new_opt = optimizer.update(grads, state.opt_state, state.params, lr)
+        if shard_weight_update:
+            new_params, new_opt = _sharded_update(state, grads, lr)
+        else:
+            # THE data-parallel step: average grads over the mesh (DDP).
+            grads = lax.pmean(grads, axis)
+            new_params, new_opt = optimizer.update(
+                grads, state.opt_state, state.params, lr
+            )
         new_state = TrainState(new_params, new_bn, new_opt, state.step + 1)
 
         # Replica-averaged metrics, fused into the same program
@@ -123,14 +140,56 @@ def make_train_step(
         }
         return new_state, metrics
 
+    def _sharded_update(state: TrainState, grads, lr):
+        """reduce-scatter grads → update own param shard with sharded
+        momentum → all-gather params (arXiv:2004.13336)."""
+        from jax.flatten_util import ravel_pytree  # noqa: PLC0415
+
+        flat_g, _ = ravel_pytree(grads)
+        flat_p, unravel = ravel_pytree(state.params)
+        L = flat_g.shape[0]
+        chunk = -(-L // n_axis)
+        pad = chunk * n_axis - L
+        g_shard = lax.psum_scatter(
+            jnp.pad(flat_g / n_axis, (0, pad)), axis, scatter_dimension=0, tiled=True
+        )
+        idx = lax.axis_index(axis)
+        p_shard = lax.dynamic_slice_in_dim(jnp.pad(flat_p, (0, pad)), idx * chunk, chunk)
+        new_p_shard, new_b_shard = optimizer.update(
+            g_shard, state.opt_state, p_shard, lr
+        )
+        flat_new = lax.all_gather(new_p_shard, axis, tiled=True)[:L]
+        return unravel(flat_new), new_b_shard
+
+    state_spec = TrainState(
+        params=P(),
+        bn_state=P(),
+        opt_state=P(axis) if shard_weight_update else P(),
+        step=P(),
+    )
     sharded = shard_map(
         step_local,
         mesh=mesh,
-        in_specs=(P(), P(axis), P(axis), P()),
-        out_specs=(P(), P()),
+        in_specs=(state_spec, P(axis), P(axis), P()),
+        out_specs=(state_spec, P()),
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def init_sharded_opt_state(params, mesh: Mesh, axis: str = mesh_lib.DATA_AXIS):
+    """Flat, axis-sharded momentum buffer for ``shard_weight_update`` steps:
+    one f32 vector of ceil(L/n)*n zeros laid over the axis (each replica
+    holds its 1/n shard)."""
+    from jax.flatten_util import ravel_pytree  # noqa: PLC0415
+    from jax.sharding import NamedSharding  # noqa: PLC0415
+
+    L = ravel_pytree(params)[0].shape[0]
+    n = int(mesh.shape[axis])
+    chunk = -(-L // n)
+    return jax.device_put(
+        jnp.zeros((chunk * n,), jnp.float32), NamedSharding(mesh, P(axis))
+    )
 
 
 def make_eval_step(

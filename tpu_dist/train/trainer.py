@@ -147,6 +147,14 @@ class Trainer:
         state = TrainState.create(params, bn_state, self.optimizer)
         # replicate across the mesh (DDP's init-time param broadcast)
         self.state = jax.device_put(state, mesh_lib.replicated(self.mesh))
+        if cfg.shard_weight_update:
+            from tpu_dist.train.step import init_sharded_opt_state  # noqa: PLC0415
+
+            if cfg.fused_epoch:
+                raise ValueError("shard_weight_update is not supported with fused_epoch yet")
+            self.state = self.state._replace(
+                opt_state=init_sharded_opt_state(params, self.mesh)
+            )
         if cfg.lr_schedule == "cosine":
             self.lr_schedule = cosine_lr(cfg.lr, cfg.epochs, cfg.warmup_epochs)
         else:
@@ -158,6 +166,7 @@ class Trainer:
             grad_accum_steps=cfg.grad_accu_steps,
             sync_bn=cfg.sync_bn,
             compute_dtype=compute_dtype,
+            shard_weight_update=cfg.shard_weight_update,
         )
         self.eval_step = make_eval_step(
             self.model.apply, self.mesh, compute_dtype=compute_dtype
@@ -179,8 +188,18 @@ class Trainer:
             found = ckpt_lib.latest_checkpoint(cfg.ckpt_dir)
             if found:
                 path, epoch = found
-                restored = ckpt_lib.restore(path, state)
-                self.state = jax.device_put(restored, mesh_lib.replicated(self.mesh))
+                # template = current state (matches sharded-opt layout too)
+                restored = ckpt_lib.restore(path, self.state)
+                self.state = TrainState(
+                    params=jax.device_put(restored.params, mesh_lib.replicated(self.mesh)),
+                    bn_state=jax.device_put(restored.bn_state, mesh_lib.replicated(self.mesh)),
+                    opt_state=jax.device_put(
+                        restored.opt_state, self.state.opt_state.sharding
+                    )
+                    if cfg.shard_weight_update
+                    else jax.device_put(restored.opt_state, mesh_lib.replicated(self.mesh)),
+                    step=jax.device_put(restored.step, mesh_lib.replicated(self.mesh)),
+                )
                 self.start_epoch = epoch + 1
                 rank0_print(f"=> resumed from {path} (epoch {epoch})")
 
