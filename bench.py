@@ -183,12 +183,46 @@ def _run_fused(cfg: BenchConfig, mesh, model, optimizer, state, n_dev: int, batc
     }
 
 
+def _guarded_backend_init(timeout_s: float) -> None:
+    """Fail loudly (exit 3) if device discovery hangs — a wedged TPU tunnel
+    must not hang the calling harness forever."""
+    import os
+    import sys
+    import threading
+
+    ok = []
+
+    def probe():
+        import jax
+
+        ok.append(jax.devices())
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not ok:
+        print(
+            f"bench: device backend failed to initialize within {timeout_s:.0f}s "
+            "(TPU tunnel unreachable?)",
+            file=sys.stderr,
+            flush=True,
+        )
+        os._exit(3)
+
+
 def main() -> None:
+    import os
+
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="resnet18_cifar100", choices=sorted(CONFIGS))
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--warmup", type=int, default=10)
+    p.add_argument(
+        "--init_timeout", type=float,
+        default=float(os.environ.get("BENCH_INIT_TIMEOUT", "600")),
+    )
     args = p.parse_args()
+    _guarded_backend_init(args.init_timeout)
     print(json.dumps(run(CONFIGS[args.config], args.steps, args.warmup)))
 
 

@@ -34,6 +34,8 @@ class TrainConfig:
     lr_milestones: Tuple[int, ...] = (60, 120, 160)  # distributed.py:64
     lr_gamma: float = 0.2          # distributed.py:64
     warmup_epochs: int = 0         # cosine schedule only
+    label_smoothing: float = 0.0
+    grad_clip_norm: float = 0.0    # 0 = off; global-norm clip of reduced grads
 
     # -- TPU-native switches (replace whole reference scripts) --------------
     bf16: bool = False             # apex AMP path (distributed_apex.py) → bf16 policy
@@ -96,6 +98,8 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--weight_decay", type=float, default=d.weight_decay)
     p.add_argument("--lr_schedule", choices=("multistep", "cosine"), default=d.lr_schedule)
     p.add_argument("--warmup_epochs", type=int, default=d.warmup_epochs)
+    p.add_argument("--label_smoothing", type=float, default=d.label_smoothing)
+    p.add_argument("--grad_clip_norm", type=float, default=d.grad_clip_norm)
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--fused_epoch", action="store_true")
     p.add_argument("--shard_weight_update", "--zero1", action="store_true")

@@ -11,14 +11,19 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def cross_entropy(logits, labels, *, reduction: str = "mean"):
-    """Softmax cross-entropy with integer labels.
+def cross_entropy(logits, labels, *, reduction: str = "mean", label_smoothing: float = 0.0):
+    """Softmax cross-entropy with integer labels (optionally smoothed).
 
     Computed in f32 regardless of the compute dtype: the log-sum-exp is the
-    numerically fragile spot under bf16.
+    numerically fragile spot under bf16. ``label_smoothing=s`` mixes the
+    one-hot target with the uniform distribution (torch semantics).
     """
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    if label_smoothing > 0.0:
+        s = label_smoothing
+        uniform = -logp.mean(axis=-1)
+        nll = (1.0 - s) * nll + s * uniform
     if reduction == "mean":
         return nll.mean()
     if reduction == "sum":

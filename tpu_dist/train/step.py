@@ -54,6 +54,8 @@ def make_train_step(
     axis: str = mesh_lib.DATA_AXIS,
     donate: bool = True,
     shard_weight_update: bool = False,
+    label_smoothing: float = 0.0,
+    grad_clip_norm: float = 0.0,
 ):
     """Build ``step(state, images, labels, lr) -> (state, metrics)``.
 
@@ -81,8 +83,18 @@ def make_train_step(
         x = images.astype(compute_dtype)
         p = jax.tree_util.tree_map(lambda t: t.astype(compute_dtype), params)
         logits, new_bn = model_apply(p, bn_state, x, train=True, axis_name=bn_axis)
-        loss = F.cross_entropy(logits, labels)
+        loss = F.cross_entropy(logits, labels, label_smoothing=label_smoothing)
         return loss, (new_bn, logits)
+
+    def clip_grads(grads):
+        """Global-norm clip on the ALREADY-REDUCED grads (so the norm is the
+        true global-batch gradient norm, identical on every replica)."""
+        if grad_clip_norm <= 0.0:
+            return grads
+        sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree_util.tree_leaves(grads))
+        norm = jnp.sqrt(sq)
+        scale = jnp.minimum(1.0, grad_clip_norm / jnp.maximum(norm, 1e-12))
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
@@ -124,6 +136,7 @@ def make_train_step(
         else:
             # THE data-parallel step: average grads over the mesh (DDP).
             grads = lax.pmean(grads, axis)
+            grads = clip_grads(grads)
             new_params, new_opt = optimizer.update(
                 grads, state.opt_state, state.params, lr
             )
@@ -153,6 +166,10 @@ def make_train_step(
         g_shard = lax.psum_scatter(
             jnp.pad(flat_g / n_axis, (0, pad)), axis, scatter_dimension=0, tiled=True
         )
+        if grad_clip_norm > 0.0:  # global norm from shard norms (one psum)
+            sq = lax.psum(jnp.sum(jnp.square(g_shard)), axis)
+            scale = jnp.minimum(1.0, grad_clip_norm / jnp.maximum(jnp.sqrt(sq), 1e-12))
+            g_shard = g_shard * scale
         idx = lax.axis_index(axis)
         p_shard = lax.dynamic_slice_in_dim(jnp.pad(flat_p, (0, pad)), idx * chunk, chunk)
         new_p_shard, new_b_shard = optimizer.update(

@@ -167,6 +167,8 @@ class Trainer:
             sync_bn=cfg.sync_bn,
             compute_dtype=compute_dtype,
             shard_weight_update=cfg.shard_weight_update,
+            label_smoothing=cfg.label_smoothing,
+            grad_clip_norm=cfg.grad_clip_norm,
         )
         self.eval_step = make_eval_step(
             self.model.apply, self.mesh, compute_dtype=compute_dtype
