@@ -1,0 +1,16 @@
+# Convenience targets; CI / the driver call the underlying commands directly.
+
+.PHONY: test bench csrc clean
+
+csrc:
+	$(MAKE) -C tpu_dist/csrc
+
+test:
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+clean:
+	$(MAKE) -C tpu_dist/csrc clean
+	find . -name __pycache__ -type d -exec rm -rf {} +
