@@ -3,7 +3,6 @@
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 
 from tpu_dist.nn import layers as L
 from tpu_dist.nn.resnet import ResNetDef

@@ -3,7 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
+
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 

@@ -11,7 +11,6 @@ import socket
 import subprocess
 import sys
 
-import pytest
 
 _WORKER = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
 

@@ -16,7 +16,7 @@ over a 2-D DP×SP mesh; patch tokens must then arrive sharded over that axis.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
