@@ -215,6 +215,7 @@ def main() -> None:
 
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="resnet18_cifar100", choices=sorted(CONFIGS))
+    p.add_argument("--all", action="store_true", help="run every config (one line each)")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--warmup", type=int, default=10)
     p.add_argument(
@@ -222,8 +223,19 @@ def main() -> None:
         default=float(os.environ.get("BENCH_INIT_TIMEOUT", "600")),
     )
     args = p.parse_args()
+
+    # persistent XLA compile cache: repeat bench invocations skip the
+    # ~20-40s first-compile cost
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
+
     _guarded_backend_init(args.init_timeout)
-    print(json.dumps(run(CONFIGS[args.config], args.steps, args.warmup)))
+    if args.all:
+        for name in sorted(CONFIGS):
+            print(json.dumps(run(CONFIGS[name], args.steps, args.warmup)))
+    else:
+        print(json.dumps(run(CONFIGS[args.config], args.steps, args.warmup)))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,46 @@
+"""Integration: accuracy (not just loss) climbs on a learnable task.
+
+The reference's implicit integration test is run-to-convergence on
+CIFAR-100 (SURVEY §4); with no dataset in this environment, a deterministic
+learnable mapping (labels = quadrant of the brightest image region) stands
+in: a model that generalizes must push accuracy well above chance.
+"""
+
+import jax
+import numpy as np
+
+from tpu_dist.comm import mesh as mesh_lib
+from tpu_dist.train.optim import SGD
+from tpu_dist.train.state import TrainState
+from tpu_dist.train.step import make_train_step
+from tests.helpers import TinyConvNet
+
+
+def _learnable_batch(n, rng):
+    """Images whose label is the quadrant (0-3) containing the bright blob."""
+    x = rng.normal(scale=0.3, size=(n, 8, 8, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, n).astype(np.int32)
+    for i, lab in enumerate(labels):
+        r, c = divmod(int(lab), 2)
+        x[i, r * 4 : r * 4 + 4, c * 4 : c * 4 + 4, :] += 2.0
+    return x, labels
+
+
+def test_accuracy_rises_above_chance():
+    mesh = mesh_lib.data_parallel_mesh()
+    model = TinyConvNet(num_classes=4, width=16)
+    opt = SGD(momentum=0.9, weight_decay=1e-4)
+    params, bn = model.init(jax.random.PRNGKey(0))
+    state = jax.device_put(TrainState.create(params, bn, opt), mesh_lib.replicated(mesh))
+    step = make_train_step(model.apply, opt, mesh)
+
+    rng = np.random.default_rng(0)
+    accs = []
+    for i in range(80):
+        x, y = _learnable_batch(64, rng)
+        xs = mesh_lib.shard_batch(mesh, x)
+        ys = mesh_lib.shard_batch(mesh, y)
+        state, m = step(state, xs, ys, 0.05)
+        accs.append(float(m["acc1"]))
+    # fresh data every step → this is generalization, not memorization
+    assert np.mean(accs[-10:]) > 60.0, np.mean(accs[-10:])  # chance = 25%
