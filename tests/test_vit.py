@@ -20,6 +20,23 @@ def test_vit_b16_param_count():
     assert 85e6 < n < 88e6, n
 
 
+def test_vit_b16_accepts_smaller_images():
+    # --model vit_b16 on CIFAR-sized input: uses the leading pos embeddings
+    m = vit_b16(num_classes=10)
+    p, s = m.init(jax.random.PRNGKey(0))
+    logits, _ = m.apply(p, s, jax.random.normal(jax.random.PRNGKey(1), (1, 32, 32, 3)))
+    assert logits.shape == (1, 10)
+
+
+def test_vit_rejects_oversized_images():
+    import pytest
+
+    m = vit_tiny(image_size=32)
+    p, s = m.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="positional"):
+        m.apply(p, s, jax.random.normal(jax.random.PRNGKey(1), (1, 64, 64, 3)))
+
+
 def test_vit_forward_shape():
     m = vit_tiny()
     p, s = m.init(jax.random.PRNGKey(0))

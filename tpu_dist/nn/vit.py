@@ -122,6 +122,15 @@ class ViTDef:
             idx = jax.lax.axis_index(seq_axis)
             s_loc = t.shape[1]
             pos = jax.lax.dynamic_slice_in_dim(pos, idx * s_loc + pos_offset, s_loc)
+        else:
+            if t.shape[1] > pos.shape[0]:
+                raise ValueError(
+                    f"input has {t.shape[1]} patch tokens but the positional "
+                    f"embedding holds {pos.shape[0]} (image_size={self.image_size}, "
+                    f"patch_size={self.patch_size}); build the model with the "
+                    f"matching image_size"
+                )
+            pos = pos[: t.shape[1]]  # smaller inputs use the leading positions
         t = t + pos[None]
 
         h_dim = self.dim // self.heads

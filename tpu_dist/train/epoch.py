@@ -41,6 +41,12 @@ from tpu_dist.train.state import TrainState
 def put_dataset_on_device(mesh: Mesh, images_u8: np.ndarray, labels: np.ndarray):
     """Shard the uint8 dataset over the data axis (one global shuffle first
     so per-shard shuffling stays representative)."""
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "fused_epoch currently supports single-host runs; multi-host "
+            "device-resident data needs make_array_from_process_local_data "
+            "placement — use the streaming trainer there"
+        )
     n = (len(images_u8) // mesh.devices.size) * mesh.devices.size
     perm = np.random.default_rng(0).permutation(len(images_u8))[:n]
     sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
