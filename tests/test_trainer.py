@@ -45,6 +45,18 @@ def test_invalid_grad_accum_rejected():
         Trainer(_cfg(batch_size=8, grad_accu_steps=3))
 
 
+def test_vit_through_trainer_registry():
+    cfg = _cfg(model="vit_tiny", num_classes=10, steps_per_epoch=2)
+    out = Trainer(cfg).train_epoch(0)
+    assert np.isfinite(out["loss"])
+
+
+def test_fused_optimizer_through_trainer():
+    cfg = _cfg(fused_optimizer=True, steps_per_epoch=2)
+    out = Trainer(cfg).train_epoch(0)
+    assert np.isfinite(out["loss"])
+
+
 def test_config_argparse_bridge():
     import argparse
 

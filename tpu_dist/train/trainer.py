@@ -142,7 +142,11 @@ class Trainer:
         )
 
         # -- model / optimizer state ----------------------------------------
-        self.optimizer = SGD(momentum=cfg.momentum, weight_decay=cfg.weight_decay)
+        self.optimizer = SGD(
+            momentum=cfg.momentum,
+            weight_decay=cfg.weight_decay,
+            fused=cfg.fused_optimizer,
+        )
         params, bn_state = self.model.init(jax.random.PRNGKey(seed))
         state = TrainState.create(params, bn_state, self.optimizer)
         # replicate across the mesh (DDP's init-time param broadcast)
