@@ -56,6 +56,10 @@ class TrainConfig:
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
 
+    # -- mesh shape ----------------------------------------------------------
+    sp: int = 1                    # sequence-parallel ways (DPxSP mesh);
+                                   # model must support seq_axis (ViT)
+
     # -- checkpoint / eval cadence -----------------------------------------
     ckpt_dir: Optional[str] = None
     save_every: int = 15           # dead utils/config.py:7 'save_epoch', made real
@@ -113,6 +117,7 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--num_classes", type=int, default=d.num_classes)
     p.add_argument("--num_processes", type=int, default=None)
     p.add_argument("--process_id", type=int, default=None)
+    p.add_argument("--sp", type=int, default=d.sp)
     p.add_argument("--ckpt_dir", type=str, default=None)
     p.add_argument("--keep_last_ckpts", type=int, default=None)
     p.add_argument("--resume", action="store_true")

@@ -42,6 +42,7 @@ class DataLoader:
         seed: int = 0,
         prefetch: int = 2,
         with_mask: bool = False,
+        batch_divisor: Optional[int] = None,
     ):
         """``batch_size`` is the PER-PROCESS batch (the reference's manual
         ``global_batch / nprocs`` split, ``distributed.py:67``, happens in
@@ -52,10 +53,11 @@ class DataLoader:
         (gather + augment + normalize in one pass — the native C++ pipeline,
         ``tpu_dist.data.native.gather_augment``); when given it replaces
         ``transform``/``eval_transform``."""
-        n_local = mesh_lib.local_device_count()
+        n_local = batch_divisor or mesh_lib.local_device_count()
         if batch_size % n_local:
             raise ValueError(
-                f"per-process batch {batch_size} must divide over {n_local} local devices"
+                f"per-process batch {batch_size} must divide over {n_local} "
+                f"(local data-parallel) devices"
             )
         self.images = images
         self.labels = labels

@@ -116,6 +116,15 @@ class ViTDef:
         del axis_name
         if tokens is None:
             tokens = self.patchify(x)
+            if seq_axis is not None:
+                # x arrived replicated over the seq axis: each device keeps
+                # only its contiguous token chunk (ring attention owns the
+                # cross-chunk interaction)
+                n_sp = jax.lax.axis_size(seq_axis)
+                s_loc = tokens.shape[1] // n_sp
+                tokens = jax.lax.dynamic_slice_in_dim(
+                    tokens, jax.lax.axis_index(seq_axis) * s_loc, s_loc, axis=1
+                )
         t = _dense(params["patch"], tokens)
         pos = params["pos"].astype(t.dtype)
         if seq_axis is not None:

@@ -56,6 +56,7 @@ def make_train_step(
     shard_weight_update: bool = False,
     label_smoothing: float = 0.0,
     grad_clip_norm: float = 0.0,
+    seq_axis: str | None = None,
 ):
     """Build ``step(state, images, labels, lr) -> (state, metrics)``.
 
@@ -74,15 +75,26 @@ def make_train_step(
     allreduce+full-update at large scale. The optimizer state becomes one
     flat f32 array per replica — build it with
     :func:`init_sharded_opt_state`.
+
+    ``seq_axis``: sequence-parallel training over a 2-D mesh (DP×SP). The
+    batch stays sharded on ``axis`` and replicated over ``seq_axis``; the
+    model (e.g. ViT) slices its own token chunk and runs ring attention
+    over the axis. Parameter gradients are ``pmean``-ed over ``seq_axis``
+    on top of the ``pmean`` over the data axis (each shard differentiates a
+    full loss replica). Incompatible with ``shard_weight_update`` and
+    SyncBN models for now.
     """
     bn_axis = axis if sync_bn else None
     K = int(grad_accum_steps)
     n_axis = int(mesh.shape[axis])
+    if seq_axis is not None and shard_weight_update:
+        raise ValueError("seq_axis + shard_weight_update not supported together")
 
     def loss_fn(params, bn_state, images, labels):
         x = images.astype(compute_dtype)
         p = jax.tree_util.tree_map(lambda t: t.astype(compute_dtype), params)
-        logits, new_bn = model_apply(p, bn_state, x, train=True, axis_name=bn_axis)
+        kw = {"seq_axis": seq_axis} if seq_axis is not None else {}
+        logits, new_bn = model_apply(p, bn_state, x, train=True, axis_name=bn_axis, **kw)
         loss = F.cross_entropy(logits, labels, label_smoothing=label_smoothing)
         return loss, (new_bn, logits)
 
@@ -136,6 +148,12 @@ def make_train_step(
         else:
             # THE data-parallel step: average grads over the mesh (DDP).
             grads = lax.pmean(grads, axis)
+            if seq_axis is not None:
+                # every seq shard differentiates a full replica of the loss,
+                # so local grads sum to n× the true gradient — MEAN over the
+                # axis recovers it (verified empirically vs single-device,
+                # tests/test_seq_parallel_training.py)
+                grads = lax.pmean(grads, seq_axis)
             grads = clip_grads(grads)
             new_params, new_opt = optimizer.update(
                 grads, state.opt_state, state.params, lr

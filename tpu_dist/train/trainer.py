@@ -87,9 +87,31 @@ class Trainer:
             num_processes=cfg.num_processes,
             process_id=cfg.process_id,
         )
-        self.mesh = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+        if mesh is not None:
+            self.mesh = mesh
+        elif cfg.sp > 1:
+            n = len(jax.devices())
+            if n % cfg.sp:
+                raise ValueError(f"{n} devices not divisible by sp={cfg.sp}")
+            self.mesh = mesh_lib.device_mesh(
+                [n // cfg.sp, cfg.sp], [mesh_lib.DATA_AXIS, mesh_lib.SEQ_AXIS]
+            )
+        else:
+            self.mesh = mesh_lib.data_parallel_mesh()
+        # data-parallel width (batch divides over this, not over SP ways)
+        self.n_data = int(self.mesh.shape[mesh_lib.DATA_AXIS])
         self.n_devices = int(self.mesh.devices.size)
         self.model = build_model(cfg)
+        if cfg.sp > 1:
+            import inspect  # noqa: PLC0415
+
+            if "seq_axis" not in inspect.signature(self.model.apply).parameters:
+                raise ValueError(
+                    f"model {cfg.model!r} does not support sequence parallelism "
+                    f"(no seq_axis in apply); use a ViT model or sp=1"
+                )
+            if cfg.fused_epoch:
+                raise ValueError("sp > 1 is not supported with fused_epoch")
 
         # -- data ------------------------------------------------------------
         if cfg.dataset == "synthetic":
@@ -107,11 +129,12 @@ class Trainer:
         # reference: per-worker batch = global / nprocs (distributed.py:67);
         # here the per-process slice is further split over local chips by
         # the batch sharding, and grad accumulation slices it once more.
-        if cfg.batch_size % self.n_devices:
+        if cfg.batch_size % self.n_data:
             raise ValueError(
-                f"batch_size {cfg.batch_size} must divide over {self.n_devices} devices"
+                f"batch_size {cfg.batch_size} must divide over {self.n_data} "
+                f"data-parallel devices"
             )
-        per_device = cfg.batch_size // self.n_devices
+        per_device = cfg.batch_size // self.n_data
         if per_device % cfg.grad_accu_steps:
             raise ValueError(
                 f"per-device batch {per_device} must divide by grad_accu_steps="
@@ -130,15 +153,16 @@ class Trainer:
         # fused C++ gather+crop+normalize when built; numpy otherwise
         from tpu_dist.data import native  # noqa: PLC0415
 
+        divisor = max(1, self.n_data // nproc)
         self.train_loader = DataLoader(
             *self.train_data, self.local_batch, self.train_sampler, self.mesh,
             gather_transform=functools.partial(native.gather_augment, train=True),
-            seed=seed, prefetch=cfg.num_workers,
+            seed=seed, prefetch=cfg.num_workers, batch_divisor=divisor,
         )
         self.test_loader = DataLoader(
             *self.test_data, self.local_batch, self.test_sampler, self.mesh,
             gather_transform=functools.partial(native.gather_augment, train=False),
-            seed=seed, with_mask=True, prefetch=cfg.num_workers,
+            seed=seed, with_mask=True, prefetch=cfg.num_workers, batch_divisor=divisor,
         )
 
         # -- model / optimizer state ----------------------------------------
@@ -173,6 +197,7 @@ class Trainer:
             shard_weight_update=cfg.shard_weight_update,
             label_smoothing=cfg.label_smoothing,
             grad_clip_norm=cfg.grad_clip_norm,
+            seq_axis=mesh_lib.SEQ_AXIS if cfg.sp > 1 else None,
         )
         self.eval_step = make_eval_step(
             self.model.apply, self.mesh, compute_dtype=compute_dtype
