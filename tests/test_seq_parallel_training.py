@@ -60,13 +60,14 @@ def test_trainer_sp_e2e():
 
     cfg = TrainConfig(
         dataset="synthetic", model="vit_tiny", num_classes=10, batch_size=16,
-        epochs=1, steps_per_epoch=2, log_every=1, lr=0.05, eval_every=0,
-        sp=4, sync_bn=False, synthetic_n=512,
+        epochs=1, steps_per_epoch=2, log_every=1, lr=0.05, eval_every=1,
+        sp=4, sync_bn=False, synthetic_n=160,
     )
     t = Trainer(cfg)
     assert t.n_data == 2 and t.n_devices == 8
-    out = t.train_epoch(0)
+    out = t.fit()  # train + distributed eval, both over the 2-D mesh
     assert np.isfinite(out["loss"])
+    assert "val_top1" in out
 
 
 def test_trainer_sp_rejects_non_sp_model():

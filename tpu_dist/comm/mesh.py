@@ -125,8 +125,9 @@ def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
     return NamedSharding(mesh, P(axis))
 
 
-def shard_batch(mesh: Mesh, batch, axis: str = DATA_AXIS):
-    """Place a process-local numpy batch onto the mesh, sharded on ``axis``.
+def shard_batch(mesh: Mesh, batch, axis=DATA_AXIS):
+    """Place a process-local numpy batch onto the mesh, sharded on ``axis``
+    (a mesh axis name, or a tuple of names to split dim 0 over several axes).
 
     Replaces the reference's per-rank ``.cuda(local_rank, non_blocking=True)``
     H2D copies (``distributed.py:88-89``): here ONE process feeds all its

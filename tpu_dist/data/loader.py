@@ -43,6 +43,7 @@ class DataLoader:
         prefetch: int = 2,
         with_mask: bool = False,
         batch_divisor: Optional[int] = None,
+        shard_axes=mesh_lib.DATA_AXIS,
     ):
         """``batch_size`` is the PER-PROCESS batch (the reference's manual
         ``global_batch / nprocs`` split, ``distributed.py:67``, happens in
@@ -70,6 +71,7 @@ class DataLoader:
         self.seed = seed
         self.prefetch = max(1, prefetch)
         self.with_mask = with_mask
+        self.shard_axes = shard_axes
 
     def __len__(self) -> int:
         return len(self.sampler) // self.batch_size if self.sampler.drop_last else -(
@@ -118,7 +120,7 @@ class DataLoader:
         def producer():
             try:
                 for hb in self._host_batches():
-                    batch = mesh_lib.shard_batch(self.mesh, hb)
+                    batch = mesh_lib.shard_batch(self.mesh, hb, self.shard_axes)
                     # bounded put that notices consumer abandonment (e.g. the
                     # trainer's steps_per_epoch early break) instead of
                     # blocking forever and leaking the thread + device batches

@@ -121,6 +121,12 @@ class ViTDef:
                 # only its contiguous token chunk (ring attention owns the
                 # cross-chunk interaction)
                 n_sp = jax.lax.axis_size(seq_axis)
+                if tokens.shape[1] % n_sp:
+                    raise ValueError(
+                        f"sequence of {tokens.shape[1]} patch tokens does not "
+                        f"divide over {n_sp} sequence-parallel devices — "
+                        f"tokens would be silently dropped"
+                    )
                 s_loc = tokens.shape[1] // n_sp
                 tokens = jax.lax.dynamic_slice_in_dim(
                     tokens, jax.lax.axis_index(seq_axis) * s_loc, s_loc, axis=1

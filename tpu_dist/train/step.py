@@ -232,7 +232,7 @@ def make_eval_step(
     mesh: Mesh,
     *,
     compute_dtype=jnp.float32,
-    axis: str = mesh_lib.DATA_AXIS,
+    axis=mesh_lib.DATA_AXIS,
 ):
     """Build ``eval_step(state, images, labels, mask) -> sums``.
 
@@ -241,6 +241,11 @@ def make_eval_step(
     averages per-batch averages over padded shards (the double-count noted
     in SURVEY §3.4). ``mask`` is 1.0 for real examples, 0.0 for sampler
     padding.
+
+    ``axis`` may be a tuple of mesh axes: on a 2-D DP×SP mesh pass
+    ``("data", "seq")`` so the eval batch shards over EVERY device (eval
+    needs no sequence parallelism — different devices just hold different
+    examples).
     """
 
     def eval_local(state: TrainState, images, labels, mask):

@@ -112,6 +112,17 @@ class Trainer:
                 )
             if cfg.fused_epoch:
                 raise ValueError("sp > 1 is not supported with fused_epoch")
+            n_tokens = getattr(self.model, "n_patches", None)
+            if n_tokens is not None and n_tokens % cfg.sp:
+                raise ValueError(
+                    f"model has {n_tokens} patch tokens, not divisible by "
+                    f"sp={cfg.sp} — tokens would be dropped"
+                )
+            if cfg.batch_size % self.n_devices:
+                raise ValueError(
+                    f"with sp>1, batch_size {cfg.batch_size} must also divide "
+                    f"over all {self.n_devices} devices for evaluation sharding"
+                )
 
         # -- data ------------------------------------------------------------
         if cfg.dataset == "synthetic":
@@ -154,6 +165,11 @@ class Trainer:
         from tpu_dist.data import native  # noqa: PLC0415
 
         divisor = max(1, self.n_data // nproc)
+        # eval shards over EVERY device (incl. seq ways — no SP needed there)
+        eval_divisor = max(1, self.n_devices // nproc)
+        eval_axes = (
+            (mesh_lib.DATA_AXIS, mesh_lib.SEQ_AXIS) if cfg.sp > 1 else mesh_lib.DATA_AXIS
+        )
         self.train_loader = DataLoader(
             *self.train_data, self.local_batch, self.train_sampler, self.mesh,
             gather_transform=functools.partial(native.gather_augment, train=True),
@@ -162,7 +178,8 @@ class Trainer:
         self.test_loader = DataLoader(
             *self.test_data, self.local_batch, self.test_sampler, self.mesh,
             gather_transform=functools.partial(native.gather_augment, train=False),
-            seed=seed, with_mask=True, prefetch=cfg.num_workers, batch_divisor=divisor,
+            seed=seed, with_mask=True, prefetch=cfg.num_workers,
+            batch_divisor=eval_divisor, shard_axes=eval_axes,
         )
 
         # -- model / optimizer state ----------------------------------------
@@ -200,7 +217,7 @@ class Trainer:
             seq_axis=mesh_lib.SEQ_AXIS if cfg.sp > 1 else None,
         )
         self.eval_step = make_eval_step(
-            self.model.apply, self.mesh, compute_dtype=compute_dtype
+            self.model.apply, self.mesh, compute_dtype=compute_dtype, axis=eval_axes
         )
 
         self._fused_runner = None
