@@ -1,0 +1,39 @@
+"""Interrupt-safe checkpointing: a KeyboardInterrupt mid-fit snapshots."""
+
+import numpy as np
+import pytest
+
+from tpu_dist.config import TrainConfig
+from tpu_dist.train.trainer import Trainer, register_model
+from tpu_dist.ckpt import latest_checkpoint
+from tests.helpers import tiny_resnet
+
+register_model("tiny_resnet_i", lambda num_classes=10: tiny_resnet(num_classes))
+
+
+def test_interrupt_saves_emergency_checkpoint(tmp_path, monkeypatch):
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet_i", num_classes=10,
+        batch_size=64, epochs=5, steps_per_epoch=1, log_every=10,
+        eval_every=0, ckpt_dir=str(tmp_path), save_every=100,
+        synthetic_n=640,
+    )
+    t = Trainer(cfg)
+    calls = {"n": 0}
+    orig = t.train_epoch
+
+    def interrupting(epoch):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise KeyboardInterrupt
+        return orig(epoch)
+
+    monkeypatch.setattr(t, "train_epoch", interrupting)
+    with pytest.raises(KeyboardInterrupt):
+        t.fit()
+    found = latest_checkpoint(str(tmp_path))
+    assert found is not None  # emergency snapshot written
+    # resume picks it up
+    t2 = Trainer(cfg.replace(resume=True))
+    assert t2.start_epoch >= 1
+    assert np.isfinite(float(t2.state.params["fc"]["b"][0]))

@@ -340,7 +340,20 @@ class Trainer:
 
         history = MetricsHistory(cfg.log_file)
         last = {}
+        self._last_epoch = self.start_epoch
+        try:
+            return self._fit_loop(epochs, history, last)
+        except KeyboardInterrupt:
+            # emergency snapshot so a manual stop never loses progress
+            if cfg.ckpt_dir:
+                ckpt_lib.save(cfg.ckpt_dir, self.state, self._last_epoch, cfg.keep_last_ckpts)
+                rank0_print(f"=> interrupted; state saved to {cfg.ckpt_dir}")
+            raise
+
+    def _fit_loop(self, epochs: int, history, last: dict) -> dict:
+        cfg = self.cfg
         for epoch in range(self.start_epoch, epochs):
+            self._last_epoch = epoch
             if cfg.profile_dir and epoch == self.start_epoch:
                 from tpu_dist.metrics.profiler import trace  # noqa: PLC0415
 
