@@ -47,3 +47,17 @@ def test_restore_shape_mismatch_is_loud(tmp_path):
 
 def test_missing_dir_is_none():
     assert latest_checkpoint("/tmp/definitely_missing_dir_xyz") is None
+
+
+def test_save_best_roundtrip(tmp_path):
+    from tpu_dist.ckpt import save_best
+
+    st = _state()
+    path = save_best(str(tmp_path), st, epoch=4, metric=71.2)
+    assert path.endswith("ckpt_best.npz")
+    rt = restore(path, _state(seed=5))
+    np.testing.assert_allclose(
+        np.asarray(rt.params["w"]), np.asarray(st.params["w"])
+    )
+    # best ckpt is not picked up by latest_checkpoint (epoch-numbered only)
+    assert latest_checkpoint(str(tmp_path)) is None

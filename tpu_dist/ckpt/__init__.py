@@ -1,1 +1,1 @@
-from tpu_dist.ckpt.checkpoint import latest_checkpoint, restore, save  # noqa: F401
+from tpu_dist.ckpt.checkpoint import latest_checkpoint, restore, save, save_best  # noqa: F401

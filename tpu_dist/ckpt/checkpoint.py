@@ -81,6 +81,23 @@ def save(
     return path
 
 
+def save_best(ckpt_dir: str, state: TrainState, epoch: int, metric: float) -> Optional[str]:
+    """Write/overwrite ``ckpt_best.npz`` (rank-0, atomic) tagging the metric."""
+    if jax.process_index() != 0:
+        return None
+    os.makedirs(ckpt_dir, exist_ok=True)
+    flat = _flatten(state._asdict())
+    flat["__meta__"] = np.frombuffer(
+        json.dumps({"epoch": epoch, "metric": metric}).encode(), dtype=np.uint8
+    )
+    path = os.path.join(ckpt_dir, "ckpt_best.npz")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **flat)
+    os.replace(tmp, path)
+    return path
+
+
 def latest_checkpoint(ckpt_dir: str) -> Optional[Tuple[str, int]]:
     """Returns ``(path, epoch)`` of the newest complete checkpoint."""
     if not os.path.isdir(ckpt_dir):

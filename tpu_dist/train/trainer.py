@@ -352,6 +352,7 @@ class Trainer:
 
     def _fit_loop(self, epochs: int, history, last: dict) -> dict:
         cfg = self.cfg
+        best_top1 = -1.0
         for epoch in range(self.start_epoch, epochs):
             self._last_epoch = epoch
             if cfg.profile_dir and epoch == self.start_epoch:
@@ -368,6 +369,9 @@ class Trainer:
                 )
                 last.update(val_top1=t1, val_top5=t5, val_loss=vloss)
                 history.log("eval", epoch=epoch, top1=t1, top5=t5, loss=vloss)
+                if cfg.ckpt_dir and t1 > best_top1:
+                    best_top1 = t1
+                    ckpt_lib.save_best(cfg.ckpt_dir, self.state, epoch, t1)
             if cfg.ckpt_dir and (epoch + 1) % cfg.save_every == 0:
                 ckpt_lib.save(cfg.ckpt_dir, self.state, epoch, cfg.keep_last_ckpts)
         if cfg.ckpt_dir:
