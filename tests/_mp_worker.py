@@ -78,6 +78,24 @@ def main(coordinator: str, num_procs: int, proc_id: int) -> None:
     p0 = float(np.asarray(state.params["fc"]["b"].addressable_shards[0].data)[0])
     print(f"RESULT {proc_id} loss={loss:.6f} p0={p0:.6f}", flush=True)
 
+    # fused device-resident epoch, multi-host placement
+    from tpu_dist.data import synthetic_cifar
+    from tpu_dist.train.epoch import make_fused_epoch, put_dataset_on_device
+
+    imgs, lbls = synthetic_cifar(128, 10, image_size=8, seed=0)
+    dx, dy = put_dataset_on_device(mesh, imgs, lbls)
+    f_params, f_bn = model.init(jax.random.PRNGKey(0))
+    f_state = jax.device_put(
+        TrainState.create(f_params, f_bn, opt), mesh_lib.replicated(mesh)
+    )
+    import jax.numpy as jnp
+
+    runner = make_fused_epoch(
+        model.apply, opt, mesh, batch_per_device=4, compute_dtype=jnp.float32
+    )
+    f_state, fm = runner(f_state, dx, dy, 0.1, 0)
+    print(f"FUSED {proc_id} loss={float(fm['loss']):.6f}", flush=True)
+
 
 if __name__ == "__main__":
     main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))

@@ -48,12 +48,18 @@ def test_two_process_training_agrees():
         outs.append(out)
         assert p.returncode == 0, out
 
-    results = {}
+    results, fused = {}, {}
     for out in outs:
         for line in out.splitlines():
             if line.startswith("RESULT"):
                 _, pid, loss, p0 = line.split()
                 results[pid] = (loss, p0)
+            elif line.startswith("FUSED"):
+                _, pid, loss = line.split()
+                fused[pid] = loss
     assert set(results) == {"0", "1"}, outs
     # both hosts see the same reduced loss and identical replicated params
     assert results["0"] == results["1"], results
+    # fused device-resident epoch also agrees across hosts
+    assert set(fused) == {"0", "1"}, outs
+    assert fused["0"] == fused["1"], fused
