@@ -59,6 +59,8 @@ class TrainConfig:
     # -- mesh shape ----------------------------------------------------------
     sp: int = 1                    # sequence-parallel ways (DPxSP mesh);
                                    # model must support seq_axis (ViT)
+    tp: int = 1                    # tensor-parallel ways (DPxTP mesh);
+                                   # model must support tp_axis (ViT)
 
     # -- checkpoint / eval cadence -----------------------------------------
     ckpt_dir: Optional[str] = None
@@ -118,6 +120,7 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--num_processes", type=int, default=None)
     p.add_argument("--process_id", type=int, default=None)
     p.add_argument("--sp", type=int, default=d.sp)
+    p.add_argument("--tp", type=int, default=d.tp)
     p.add_argument("--ckpt_dir", type=str, default=None)
     p.add_argument("--keep_last_ckpts", type=int, default=None)
     p.add_argument("--resume", action="store_true")

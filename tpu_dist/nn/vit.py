@@ -47,6 +47,11 @@ def _dense(p, x):
     return x @ p["w"].astype(x.dtype) + p["b"].astype(x.dtype)
 
 
+def _dense_local(p, x):
+    """Matmul only — bias is added by the caller (after any TP psum)."""
+    return x @ p["w"].astype(x.dtype)
+
+
 @dataclass(frozen=True)
 class ViTDef:
     image_size: int = 224
@@ -89,6 +94,30 @@ class ViTDef:
 
     # -- apply ---------------------------------------------------------------
 
+    def tp_param_specs(self, axis: str):
+        """PartitionSpec pytree for Megatron TP over ``axis``: qkv/mlp1
+        column-sharded, proj/mlp2 row-sharded, everything else replicated.
+        Use for ``shard_map`` in/out specs AND for placing the params
+        (``NamedSharding(mesh, spec)`` per leaf)."""
+        from jax.sharding import PartitionSpec as P  # noqa: PLC0415
+
+        rep = {"w": P(), "b": P()}
+        block = {
+            "ln1": {"scale": P(), "bias": P()},
+            "qkv": {"w": P(None, axis), "b": P(axis)},
+            "proj": {"w": P(axis, None), "b": P()},
+            "ln2": {"scale": P(), "bias": P()},
+            "mlp1": {"w": P(None, axis), "b": P(axis)},
+            "mlp2": {"w": P(axis, None), "b": P()},
+        }
+        return {
+            "patch": dict(rep),
+            "pos": P(),
+            "blocks": [dict(block) for _ in range(self.depth)],
+            "ln_f": {"scale": P(), "bias": P()},
+            "head": dict(rep),
+        }
+
     def patchify(self, x):
         """[B, H, W, 3] → [B, N, patch_dim] in row-major patch order."""
         b, h, w, c = x.shape
@@ -106,14 +135,23 @@ class ViTDef:
         train: bool = False,
         axis_name: Optional[str] = None,  # unused (no BN); kept for contract
         seq_axis: Optional[str] = None,
+        tp_axis: Optional[str] = None,
         tokens: Optional[jnp.ndarray] = None,
         pos_offset: int = 0,
     ):
         """Forward. Either ``x`` as images [B,H,W,3] (patchified here) or
         pre-sharded ``tokens`` [B, S_local, patch_dim] for sequence-parallel
         runs (with ``pos_offset`` the global index of the first local token).
+
+        ``tp_axis``: Megatron tensor parallelism — qkv/mlp1 arrive
+        column-sharded (local heads / local hidden), proj/mlp2 row-sharded
+        with one ``psum`` each; params must be placed with
+        :meth:`tp_param_specs`. Composable with neither ``seq_axis`` nor
+        SyncBN (there is no BN).
         """
         del axis_name
+        if tp_axis is not None and seq_axis is not None:
+            raise ValueError("tp_axis and seq_axis cannot be combined yet")
         if tokens is None:
             tokens = self.patchify(x)
             if seq_axis is not None:
@@ -148,18 +186,28 @@ class ViTDef:
             pos = pos[: t.shape[1]]  # smaller inputs use the leading positions
         t = t + pos[None]
 
+        if tp_axis is not None:
+            from tpu_dist.parallel.tensor import tp_ops  # noqa: PLC0415
+
+            copy_to_tp, reduce_from_tp = tp_ops(tp_axis)
+        else:
+            copy_to_tp = reduce_from_tp = lambda v: v
+
         h_dim = self.dim // self.heads
         for blk in params["blocks"]:
-            y = _ln_apply(blk["ln1"], t)
-            qkv = _dense(blk["qkv"], y)
-            b, s, _ = qkv.shape
-            q, k, v = jnp.split(qkv.reshape(b, s, 3, self.heads, h_dim), 3, axis=2)
-            q, k, v = (a.squeeze(2) for a in (q, k, v))
+            y = copy_to_tp(_ln_apply(blk["ln1"], t))
+            qkv = _dense(blk["qkv"], y)  # col-sharded under TP: local heads
+            b, s, qkv_dim = qkv.shape
+            h_loc = qkv_dim // (3 * h_dim)
+            # layout [heads, 3, h_dim]: a contiguous column shard is whole heads
+            qkv = qkv.reshape(b, s, h_loc, 3, h_dim)
+            q, k, v = (qkv[:, :, :, i, :] for i in range(3))
             o = attn_lib.attention(q, k, v, seq_axis=seq_axis)
-            t = t + _dense(blk["proj"], o.reshape(b, s, self.dim))
-            y = _ln_apply(blk["ln2"], t)
-            y = jax.nn.gelu(_dense(blk["mlp1"], y))
-            t = t + _dense(blk["mlp2"], y)
+            proj = reduce_from_tp(_dense_local(blk["proj"], o.reshape(b, s, h_loc * h_dim)))
+            t = t + proj + blk["proj"]["b"].astype(t.dtype)
+            y = copy_to_tp(_ln_apply(blk["ln2"], t))
+            y = jax.nn.gelu(_dense(blk["mlp1"], y))  # col-sharded hidden
+            t = t + reduce_from_tp(_dense_local(blk["mlp2"], y)) + blk["mlp2"]["b"].astype(t.dtype)
 
         t = _ln_apply(params["ln_f"], t)
         pooled = t.mean(axis=1)

@@ -21,7 +21,41 @@ to ``axis``.
 
 from __future__ import annotations
 
+import functools
+
+import jax
 from jax import lax
+
+
+@functools.lru_cache(maxsize=None)
+def tp_ops(axis: str):
+    """The Megatron conjugate pair for ``axis``:
+
+    * ``copy_to_tp``  — forward identity, backward ``psum`` (the "f"
+      operator: feeds a replicated activation into column-parallel layers,
+      collecting each shard's partial cotangent on the way back).
+    * ``reduce_from_tp`` — forward ``psum``, backward identity (the "g"
+      operator: merges row-parallel partial outputs; the cotangent is
+      already replicated).
+
+    Explicit custom-VJP pairs are REQUIRED under ``shard_map``: the raw
+    ``lax.psum`` transposes as ``psum``, which double-counts when each
+    device differentiates its own replica of the loss.
+    """
+
+    @jax.custom_vjp
+    def copy_to_tp(x):
+        return x
+
+    copy_to_tp.defvjp(lambda x: (x, None), lambda _, g: (lax.psum(g, axis),))
+
+    @jax.custom_vjp
+    def reduce_from_tp(x):
+        return lax.psum(x, axis)
+
+    reduce_from_tp.defvjp(lambda x: (lax.psum(x, axis), None), lambda _, g: (g,))
+
+    return copy_to_tp, reduce_from_tp
 
 
 def shard_columns(w, axis_size: int, index: int):

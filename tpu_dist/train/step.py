@@ -57,6 +57,8 @@ def make_train_step(
     label_smoothing: float = 0.0,
     grad_clip_norm: float = 0.0,
     seq_axis: str | None = None,
+    tp_axis: str | None = None,
+    param_specs=None,
 ):
     """Build ``step(state, images, labels, lr) -> (state, metrics)``.
 
@@ -89,11 +91,23 @@ def make_train_step(
     n_axis = int(mesh.shape[axis])
     if seq_axis is not None and shard_weight_update:
         raise ValueError("seq_axis + shard_weight_update not supported together")
+    if tp_axis is not None:
+        if param_specs is None:
+            raise ValueError("tp_axis requires param_specs (per-leaf shardings)")
+        if shard_weight_update or grad_clip_norm > 0.0 or seq_axis is not None:
+            raise ValueError(
+                "tp_axis is incompatible with shard_weight_update / "
+                "grad_clip_norm / seq_axis for now"
+            )
 
     def loss_fn(params, bn_state, images, labels):
         x = images.astype(compute_dtype)
         p = jax.tree_util.tree_map(lambda t: t.astype(compute_dtype), params)
-        kw = {"seq_axis": seq_axis} if seq_axis is not None else {}
+        kw = {}
+        if seq_axis is not None:
+            kw["seq_axis"] = seq_axis
+        if tp_axis is not None:
+            kw["tp_axis"] = tp_axis
         logits, new_bn = model_apply(p, bn_state, x, train=True, axis_name=bn_axis, **kw)
         loss = F.cross_entropy(logits, labels, label_smoothing=label_smoothing)
         return loss, (new_bn, logits)
@@ -196,10 +210,11 @@ def make_train_step(
         flat_new = lax.all_gather(new_p_shard, axis, tiled=True)[:L]
         return unravel(flat_new), new_b_shard
 
+    p_spec = param_specs if param_specs is not None else P()
     state_spec = TrainState(
-        params=P(),
+        params=p_spec,
         bn_state=P(),
-        opt_state=P(axis) if shard_weight_update else P(),
+        opt_state=P(axis) if shard_weight_update else p_spec,
         step=P(),
     )
     sharded = shard_map(
@@ -233,6 +248,8 @@ def make_eval_step(
     *,
     compute_dtype=jnp.float32,
     axis=mesh_lib.DATA_AXIS,
+    tp_axis: str | None = None,
+    param_specs=None,
 ):
     """Build ``eval_step(state, images, labels, mask) -> sums``.
 
@@ -251,7 +268,8 @@ def make_eval_step(
     def eval_local(state: TrainState, images, labels, mask):
         x = images.astype(compute_dtype)
         p = jax.tree_util.tree_map(lambda t: t.astype(compute_dtype), state.params)
-        logits, _ = model_apply(p, state.bn_state, x, train=False, axis_name=None)
+        kw = {"tp_axis": tp_axis} if tp_axis is not None else {}
+        logits, _ = model_apply(p, state.bn_state, x, train=False, axis_name=None, **kw)
         nll = F.cross_entropy(logits, labels, reduction="none")
         maxk_hits = _masked_topk(logits, labels, mask)
         sums = {
@@ -268,10 +286,12 @@ def make_eval_step(
         hits = (pred == labels[:, None]).astype(jnp.float32) * mask[:, None]
         return jnp.sum(hits[:, :1]), jnp.sum(hits[:, :maxk])
 
+    p_spec = param_specs if param_specs is not None else P()
+    state_spec = TrainState(params=p_spec, bn_state=P(), opt_state=p_spec, step=P())
     sharded = shard_map(
         eval_local,
         mesh=mesh,
-        in_specs=(P(), P(axis), P(axis), P(axis)),
+        in_specs=(state_spec, P(axis), P(axis), P(axis)),
         out_specs=P(),
         check_vma=False,
     )

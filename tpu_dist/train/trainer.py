@@ -87,14 +87,18 @@ class Trainer:
             num_processes=cfg.num_processes,
             process_id=cfg.process_id,
         )
+        if cfg.sp > 1 and cfg.tp > 1:
+            raise ValueError("sp and tp cannot be combined yet")
         if mesh is not None:
             self.mesh = mesh
-        elif cfg.sp > 1:
+        elif cfg.sp > 1 or cfg.tp > 1:
+            ways = cfg.sp if cfg.sp > 1 else cfg.tp
+            second = mesh_lib.SEQ_AXIS if cfg.sp > 1 else mesh_lib.MODEL_AXIS
             n = len(jax.devices())
-            if n % cfg.sp:
-                raise ValueError(f"{n} devices not divisible by sp={cfg.sp}")
+            if n % ways:
+                raise ValueError(f"{n} devices not divisible by sp/tp={ways}")
             self.mesh = mesh_lib.device_mesh(
-                [n // cfg.sp, cfg.sp], [mesh_lib.DATA_AXIS, mesh_lib.SEQ_AXIS]
+                [n // ways, ways], [mesh_lib.DATA_AXIS, second]
             )
         else:
             self.mesh = mesh_lib.data_parallel_mesh()
@@ -123,6 +127,23 @@ class Trainer:
                     f"with sp>1, batch_size {cfg.batch_size} must also divide "
                     f"over all {self.n_devices} devices for evaluation sharding"
                 )
+        self._param_specs = None
+        if cfg.tp > 1:
+            import inspect  # noqa: PLC0415
+
+            if "tp_axis" not in inspect.signature(self.model.apply).parameters:
+                raise ValueError(
+                    f"model {cfg.model!r} does not support tensor parallelism "
+                    f"(no tp_axis in apply); use a ViT model or tp=1"
+                )
+            heads = getattr(self.model, "heads", None)
+            if heads is not None and heads % cfg.tp:
+                raise ValueError(f"{heads} heads not divisible by tp={cfg.tp}")
+            if cfg.fused_epoch or cfg.shard_weight_update or cfg.grad_clip_norm > 0:
+                raise ValueError(
+                    "tp > 1 is incompatible with fused_epoch / zero1 / grad_clip_norm"
+                )
+            self._param_specs = self.model.tp_param_specs(mesh_lib.MODEL_AXIS)
 
         # -- data ------------------------------------------------------------
         if cfg.dataset == "synthetic":
@@ -190,16 +211,11 @@ class Trainer:
         )
         params, bn_state = self.model.init(jax.random.PRNGKey(seed))
         state = TrainState.create(params, bn_state, self.optimizer)
-        # replicate across the mesh (DDP's init-time param broadcast)
-        self.state = jax.device_put(state, mesh_lib.replicated(self.mesh))
-        if cfg.shard_weight_update:
-            from tpu_dist.train.step import init_sharded_opt_state  # noqa: PLC0415
-
-            if cfg.fused_epoch:
-                raise ValueError("shard_weight_update is not supported with fused_epoch yet")
-            self.state = self.state._replace(
-                opt_state=init_sharded_opt_state(params, self.mesh)
-            )
+        if cfg.shard_weight_update and cfg.fused_epoch:
+            raise ValueError("shard_weight_update is not supported with fused_epoch yet")
+        # place on the mesh (DDP's init-time param broadcast; sharded
+        # placements for TP params / ZeRO-1 optimizer state)
+        self.state = self._place_state(state)
         if cfg.lr_schedule == "cosine":
             self.lr_schedule = cosine_lr(cfg.lr, cfg.epochs, cfg.warmup_epochs)
         else:
@@ -215,9 +231,13 @@ class Trainer:
             label_smoothing=cfg.label_smoothing,
             grad_clip_norm=cfg.grad_clip_norm,
             seq_axis=mesh_lib.SEQ_AXIS if cfg.sp > 1 else None,
+            tp_axis=mesh_lib.MODEL_AXIS if cfg.tp > 1 else None,
+            param_specs=self._param_specs,
         )
         self.eval_step = make_eval_step(
-            self.model.apply, self.mesh, compute_dtype=compute_dtype, axis=eval_axes
+            self.model.apply, self.mesh, compute_dtype=compute_dtype, axis=eval_axes,
+            tp_axis=mesh_lib.MODEL_AXIS if cfg.tp > 1 else None,
+            param_specs=self._param_specs,
         )
 
         self._fused_runner = None
@@ -236,20 +256,49 @@ class Trainer:
             found = ckpt_lib.latest_checkpoint(cfg.ckpt_dir)
             if found:
                 path, epoch = found
-                # template = current state (matches sharded-opt layout too)
+                # template = current state (matches sharded layouts too)
                 restored = ckpt_lib.restore(path, self.state)
-                self.state = TrainState(
-                    params=jax.device_put(restored.params, mesh_lib.replicated(self.mesh)),
-                    bn_state=jax.device_put(restored.bn_state, mesh_lib.replicated(self.mesh)),
-                    opt_state=jax.device_put(
-                        restored.opt_state, self.state.opt_state.sharding
-                    )
-                    if cfg.shard_weight_update
-                    else jax.device_put(restored.opt_state, mesh_lib.replicated(self.mesh)),
-                    step=jax.device_put(restored.step, mesh_lib.replicated(self.mesh)),
-                )
+                self.state = self._place_state(restored)
                 self.start_epoch = epoch + 1
                 rank0_print(f"=> resumed from {path} (epoch {epoch})")
+
+    def _place_state(self, state: TrainState) -> TrainState:
+        """Mesh placement for every supported layout: replicated (default),
+        per-leaf TP shardings, ZeRO-1 flat-sharded optimizer state."""
+        from jax.sharding import NamedSharding  # noqa: PLC0415
+
+        cfg = self.cfg
+        rep = mesh_lib.replicated(self.mesh)
+        if self._param_specs is not None:  # TP
+            place = jax.tree_util.tree_map(
+                lambda leaf, spec: jax.device_put(leaf, NamedSharding(self.mesh, spec)),
+                state.params,
+                self._param_specs,
+            )
+            opt = jax.tree_util.tree_map(
+                lambda leaf, spec: jax.device_put(leaf, NamedSharding(self.mesh, spec)),
+                state.opt_state,
+                self._param_specs,
+            )
+            return TrainState(
+                params=place,
+                bn_state=jax.device_put(state.bn_state, rep),
+                opt_state=opt,
+                step=jax.device_put(state.step, rep),
+            )
+        placed = jax.device_put(state, rep)
+        if cfg.shard_weight_update:
+            from tpu_dist.train.step import init_sharded_opt_state  # noqa: PLC0415
+
+            tmpl = init_sharded_opt_state(state.params, self.mesh)
+            opt_np = state.opt_state
+            # fresh init (tree layout) vs restored flat vector
+            if hasattr(opt_np, "shape") and getattr(opt_np, "ndim", None) == 1:
+                opt = jax.device_put(np.asarray(opt_np), tmpl.sharding)
+            else:
+                opt = tmpl  # fresh zeros
+            placed = placed._replace(opt_state=opt)
+        return placed
 
     # -- loops ---------------------------------------------------------------
 
