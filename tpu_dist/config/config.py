@@ -61,6 +61,8 @@ class TrainConfig:
                                    # model must support seq_axis (ViT)
     tp: int = 1                    # tensor-parallel ways (DPxTP mesh);
                                    # model must support tp_axis (ViT)
+    ep: int = 1                    # expert-parallel ways (DPxEP mesh);
+                                   # model must support ep_axis (ViT-MoE)
 
     # -- checkpoint / eval cadence -----------------------------------------
     ckpt_dir: Optional[str] = None
@@ -121,6 +123,7 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--process_id", type=int, default=None)
     p.add_argument("--sp", type=int, default=d.sp)
     p.add_argument("--tp", type=int, default=d.tp)
+    p.add_argument("--ep", type=int, default=d.ep)
     p.add_argument("--ckpt_dir", type=str, default=None)
     p.add_argument("--keep_last_ckpts", type=int, default=None)
     p.add_argument("--resume", action="store_true")

@@ -58,6 +58,7 @@ def make_train_step(
     grad_clip_norm: float = 0.0,
     seq_axis: str | None = None,
     tp_axis: str | None = None,
+    ep_axis: str | None = None,
     param_specs=None,
 ):
     """Build ``step(state, images, labels, lr) -> (state, metrics)``.
@@ -86,7 +87,6 @@ def make_train_step(
     full loss replica). Incompatible with ``shard_weight_update`` and
     SyncBN models for now.
     """
-    bn_axis = axis if sync_bn else None
     K = int(grad_accum_steps)
     n_axis = int(mesh.shape[axis])
     if seq_axis is not None and shard_weight_update:
@@ -99,6 +99,18 @@ def make_train_step(
                 "tp_axis is incompatible with shard_weight_update / "
                 "grad_clip_norm / seq_axis for now"
             )
+    if ep_axis is not None:
+        if param_specs is None:
+            raise ValueError("ep_axis requires param_specs (per-leaf shardings)")
+        if shard_weight_update or grad_clip_norm > 0.0 or seq_axis or tp_axis:
+            raise ValueError(
+                "ep_axis is incompatible with shard_weight_update / "
+                "grad_clip_norm / seq_axis / tp_axis for now"
+            )
+    # the expert axis doubles as a data axis outside the MoE: batch shards
+    # over both, metrics/loss reduce over both
+    batch_axes = (axis, ep_axis) if ep_axis is not None else axis
+    bn_axis = batch_axes if sync_bn else None
 
     def loss_fn(params, bn_state, images, labels):
         x = images.astype(compute_dtype)
@@ -108,6 +120,8 @@ def make_train_step(
             kw["seq_axis"] = seq_axis
         if tp_axis is not None:
             kw["tp_axis"] = tp_axis
+        if ep_axis is not None:
+            kw["ep_axis"] = ep_axis
         logits, new_bn = model_apply(p, bn_state, x, train=True, axis_name=bn_axis, **kw)
         loss = F.cross_entropy(logits, labels, label_smoothing=label_smoothing)
         return loss, (new_bn, logits)
@@ -160,14 +174,17 @@ def make_train_step(
         if shard_weight_update:
             new_params, new_opt = _sharded_update(state, grads, lr)
         else:
-            # THE data-parallel step: average grads over the mesh (DDP).
-            grads = lax.pmean(grads, axis)
-            if seq_axis is not None:
-                # every seq shard differentiates a full replica of the loss,
-                # so local grads sum to n× the true gradient — MEAN over the
-                # axis recovers it (verified empirically vs single-device,
-                # tests/test_seq_parallel_training.py)
-                grads = lax.pmean(grads, seq_axis)
+            if ep_axis is not None:
+                grads = _ep_grad_reduce(grads)
+            else:
+                # THE data-parallel step: average grads over the mesh (DDP).
+                grads = lax.pmean(grads, axis)
+                if seq_axis is not None:
+                    # every seq shard differentiates a full replica of the
+                    # loss, so local grads sum to n× the true gradient —
+                    # MEAN over the axis recovers it (verified empirically,
+                    # tests/test_seq_parallel_training.py)
+                    grads = lax.pmean(grads, seq_axis)
             grads = clip_grads(grads)
             new_params, new_opt = optimizer.update(
                 grads, state.opt_state, state.params, lr
@@ -179,11 +196,34 @@ def make_train_step(
         c1, c5 = F.topk_correct(logits.astype(jnp.float32), labels_all, (1, 5))
         b = labels_all.shape[0]
         metrics = {
-            "loss": lax.pmean(loss, axis),
-            "acc1": lax.psum(c1, axis) / (b * lax.psum(1, axis)) * 100.0,
-            "acc5": lax.psum(c5, axis) / (b * lax.psum(1, axis)) * 100.0,
+            "loss": lax.pmean(loss, batch_axes),
+            "acc1": lax.psum(c1, batch_axes) / (b * lax.psum(1, batch_axes)) * 100.0,
+            "acc5": lax.psum(c5, batch_axes) / (b * lax.psum(1, batch_axes)) * 100.0,
         }
         return new_state, metrics
+
+    def _ep_grad_reduce(grads):
+        """Per-leaf reduction under expert parallelism (rule verified
+        empirically, tests/test_expert_parallel_training.py): expert-sharded
+        leaves already aggregate the whole expert group's token
+        contributions (n_ep× scaled) → pmean over data, divide by n_ep;
+        replicated leaves are plain per-shard grads → pmean over both axes.
+        """
+        n_ep = lax.axis_size(ep_axis)
+
+        def has_ep(spec):
+            return any(
+                ep_axis in (e if isinstance(e, tuple) else (e,))
+                for e in spec
+                if e is not None
+            )
+
+        def red(g, spec):
+            if has_ep(spec):
+                return lax.pmean(g, axis) / n_ep
+            return lax.pmean(g, batch_axes)
+
+        return jax.tree_util.tree_map(red, grads, param_specs)
 
     def _sharded_update(state: TrainState, grads, lr):
         """reduce-scatter grads → update own param shard with sharded
@@ -217,10 +257,11 @@ def make_train_step(
         opt_state=P(axis) if shard_weight_update else p_spec,
         step=P(),
     )
+    batch_spec = P(batch_axes)
     sharded = shard_map(
         step_local,
         mesh=mesh,
-        in_specs=(state_spec, P(axis), P(axis), P()),
+        in_specs=(state_spec, batch_spec, batch_spec, P()),
         out_specs=(state_spec, P()),
         check_vma=False,
     )
@@ -249,6 +290,7 @@ def make_eval_step(
     compute_dtype=jnp.float32,
     axis=mesh_lib.DATA_AXIS,
     tp_axis: str | None = None,
+    ep_axis: str | None = None,
     param_specs=None,
 ):
     """Build ``eval_step(state, images, labels, mask) -> sums``.
@@ -268,7 +310,11 @@ def make_eval_step(
     def eval_local(state: TrainState, images, labels, mask):
         x = images.astype(compute_dtype)
         p = jax.tree_util.tree_map(lambda t: t.astype(compute_dtype), state.params)
-        kw = {"tp_axis": tp_axis} if tp_axis is not None else {}
+        kw = {}
+        if tp_axis is not None:
+            kw["tp_axis"] = tp_axis
+        if ep_axis is not None:
+            kw["ep_axis"] = ep_axis
         logits, _ = model_apply(p, state.bn_state, x, train=False, axis_name=None, **kw)
         nll = F.cross_entropy(logits, labels, reduction="none")
         maxk_hits = _masked_topk(logits, labels, mask)

@@ -72,6 +72,10 @@ def build_model(cfg: TrainConfig):
         _MODELS.setdefault("vit_b16", vit_b16)
         _MODELS.setdefault("vit_s16", vit_s16)
         _MODELS.setdefault("vit_tiny", vit_tiny)
+
+        from tpu_dist.nn.vit_moe import vit_moe_tiny  # noqa: PLC0415
+
+        _MODELS.setdefault("vit_moe_tiny", vit_moe_tiny)
     except ImportError:
         pass
     if cfg.model not in _MODELS:
@@ -87,16 +91,20 @@ class Trainer:
             num_processes=cfg.num_processes,
             process_id=cfg.process_id,
         )
-        if cfg.sp > 1 and cfg.tp > 1:
-            raise ValueError("sp and tp cannot be combined yet")
+        if sum(w > 1 for w in (cfg.sp, cfg.tp, cfg.ep)) > 1:
+            raise ValueError("sp, tp and ep cannot be combined yet")
         if mesh is not None:
             self.mesh = mesh
-        elif cfg.sp > 1 or cfg.tp > 1:
-            ways = cfg.sp if cfg.sp > 1 else cfg.tp
-            second = mesh_lib.SEQ_AXIS if cfg.sp > 1 else mesh_lib.MODEL_AXIS
+        elif cfg.sp > 1 or cfg.tp > 1 or cfg.ep > 1:
+            ways = max(cfg.sp, cfg.tp, cfg.ep)
+            second = (
+                mesh_lib.SEQ_AXIS if cfg.sp > 1
+                else mesh_lib.MODEL_AXIS if cfg.tp > 1
+                else mesh_lib.EXPERT_AXIS
+            )
             n = len(jax.devices())
             if n % ways:
-                raise ValueError(f"{n} devices not divisible by sp/tp={ways}")
+                raise ValueError(f"{n} devices not divisible by sp/tp/ep={ways}")
             self.mesh = mesh_lib.device_mesh(
                 [n // ways, ways], [mesh_lib.DATA_AXIS, second]
             )
@@ -144,6 +152,27 @@ class Trainer:
                     "tp > 1 is incompatible with fused_epoch / zero1 / grad_clip_norm"
                 )
             self._param_specs = self.model.tp_param_specs(mesh_lib.MODEL_AXIS)
+        if cfg.ep > 1:
+            import inspect  # noqa: PLC0415
+
+            if "ep_axis" not in inspect.signature(self.model.apply).parameters:
+                raise ValueError(
+                    f"model {cfg.model!r} does not support expert parallelism "
+                    f"(no ep_axis in apply); use a MoE model or ep=1"
+                )
+            n_exp = getattr(self.model, "n_experts", None)
+            if n_exp is not None and n_exp % cfg.ep:
+                raise ValueError(f"{n_exp} experts not divisible by ep={cfg.ep}")
+            if cfg.fused_epoch or cfg.shard_weight_update or cfg.grad_clip_norm > 0:
+                raise ValueError(
+                    "ep > 1 is incompatible with fused_epoch / zero1 / grad_clip_norm"
+                )
+            if cfg.batch_size % self.n_devices:
+                raise ValueError(
+                    f"with ep>1, batch_size {cfg.batch_size} must divide over "
+                    f"all {self.n_devices} devices (the expert axis carries data)"
+                )
+            self._param_specs = self.model.ep_param_specs(mesh_lib.EXPERT_AXIS)
 
         # -- data ------------------------------------------------------------
         if cfg.dataset == "synthetic":
@@ -185,16 +214,25 @@ class Trainer:
         # fused C++ gather+crop+normalize when built; numpy otherwise
         from tpu_dist.data import native  # noqa: PLC0415
 
-        divisor = max(1, self.n_data // nproc)
+        # EP: the expert axis carries data everywhere outside the MoE, so the
+        # TRAIN batch also shards over every device
+        train_axes = (
+            (mesh_lib.DATA_AXIS, mesh_lib.EXPERT_AXIS) if cfg.ep > 1 else mesh_lib.DATA_AXIS
+        )
+        divisor = max(1, (self.n_devices if cfg.ep > 1 else self.n_data) // nproc)
         # eval shards over EVERY device (incl. seq ways — no SP needed there)
         eval_divisor = max(1, self.n_devices // nproc)
-        eval_axes = (
-            (mesh_lib.DATA_AXIS, mesh_lib.SEQ_AXIS) if cfg.sp > 1 else mesh_lib.DATA_AXIS
-        )
+        if cfg.sp > 1:
+            eval_axes = (mesh_lib.DATA_AXIS, mesh_lib.SEQ_AXIS)
+        elif cfg.ep > 1:
+            eval_axes = (mesh_lib.DATA_AXIS, mesh_lib.EXPERT_AXIS)
+        else:
+            eval_axes = mesh_lib.DATA_AXIS
         self.train_loader = DataLoader(
             *self.train_data, self.local_batch, self.train_sampler, self.mesh,
             gather_transform=functools.partial(native.gather_augment, train=True),
             seed=seed, prefetch=cfg.num_workers, batch_divisor=divisor,
+            shard_axes=train_axes,
         )
         self.test_loader = DataLoader(
             *self.test_data, self.local_batch, self.test_sampler, self.mesh,
@@ -232,11 +270,13 @@ class Trainer:
             grad_clip_norm=cfg.grad_clip_norm,
             seq_axis=mesh_lib.SEQ_AXIS if cfg.sp > 1 else None,
             tp_axis=mesh_lib.MODEL_AXIS if cfg.tp > 1 else None,
+            ep_axis=mesh_lib.EXPERT_AXIS if cfg.ep > 1 else None,
             param_specs=self._param_specs,
         )
         self.eval_step = make_eval_step(
             self.model.apply, self.mesh, compute_dtype=compute_dtype, axis=eval_axes,
             tp_axis=mesh_lib.MODEL_AXIS if cfg.tp > 1 else None,
+            ep_axis=mesh_lib.EXPERT_AXIS if cfg.ep > 1 else None,
             param_specs=self._param_specs,
         )
 
