@@ -52,6 +52,28 @@ def _dense_local(p, x):
     return x @ p["w"].astype(x.dtype)
 
 
+def patchify(x, patch_size: int):
+    """[B, H, W, 3] → [B, N, patch_dim] in row-major patch order (shared by
+    ViTDef and ViTMoEDef)."""
+    b, h, w, c = x.shape
+    ph = pw = patch_size
+    x = x.reshape(b, h // ph, ph, w // pw, pw, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (h // ph) * (w // pw), ph * pw * c)
+
+
+def check_pos_capacity(n_tokens: int, pos_table, image_size: int, patch_size: int):
+    """Loud error when the input has more patch tokens than the positional
+    table (smaller inputs are fine — they use the leading positions)."""
+    if n_tokens > pos_table.shape[0]:
+        raise ValueError(
+            f"input has {n_tokens} patch tokens but the positional embedding "
+            f"holds {pos_table.shape[0]} (image_size={image_size}, "
+            f"patch_size={patch_size}); build the model with the matching "
+            f"image_size"
+        )
+
+
 @dataclass(frozen=True)
 class ViTDef:
     image_size: int = 224
@@ -120,11 +142,7 @@ class ViTDef:
 
     def patchify(self, x):
         """[B, H, W, 3] → [B, N, patch_dim] in row-major patch order."""
-        b, h, w, c = x.shape
-        ph = pw = self.patch_size
-        x = x.reshape(b, h // ph, ph, w // pw, pw, c)
-        x = x.transpose(0, 1, 3, 2, 4, 5)
-        return x.reshape(b, (h // ph) * (w // pw), ph * pw * c)
+        return patchify(x, self.patch_size)
 
     def apply(
         self,
@@ -176,13 +194,7 @@ class ViTDef:
             s_loc = t.shape[1]
             pos = jax.lax.dynamic_slice_in_dim(pos, idx * s_loc + pos_offset, s_loc)
         else:
-            if t.shape[1] > pos.shape[0]:
-                raise ValueError(
-                    f"input has {t.shape[1]} patch tokens but the positional "
-                    f"embedding holds {pos.shape[0]} (image_size={self.image_size}, "
-                    f"patch_size={self.patch_size}); build the model with the "
-                    f"matching image_size"
-                )
+            check_pos_capacity(t.shape[1], pos, self.image_size, self.patch_size)
             pos = pos[: t.shape[1]]  # smaller inputs use the leading positions
         t = t + pos[None]
 

@@ -10,12 +10,12 @@ Functional contract matches :class:`ViTDef` (``init``/``apply`` with
 ``ep_axis`` instead of ``tp_axis``), so it slots into the same train step
 through ``param_specs`` + a model kwarg.
 
-Gradient note: like TP, EP under per-replica loss differentiation needs the
-Megatron conjugate ops around the cross-device exchange. ``apply_ep``'s
-``all_to_all`` transposes into the reverse ``all_to_all`` (exact), and the
-router/gate math happens on local tokens, so the only correction needed is
-the ``copy_to_tp``-style psum on the block INPUT — reused from
-``tp_ops``.
+Gradient note: no conjugate ops are needed inside the model — the block
+input carries DATA (each device holds different tokens), not a replica, and
+``apply_ep``'s ``all_to_all`` transposes into the exact reverse
+``all_to_all``. The whole correction lives in the train step's per-leaf
+reduction (``tpu_dist/train/step.py::_ep_grad_reduce``): expert-sharded
+leaves ``pmean(data)/n_ep``, replicated leaves ``pmean(data, expert)``.
 """
 
 from __future__ import annotations
@@ -27,7 +27,14 @@ import jax
 import jax.numpy as jnp
 
 from tpu_dist.nn import attention as attn_lib
-from tpu_dist.nn.vit import _dense, _ln_apply, _ln_init, _dense_init
+from tpu_dist.nn.vit import (
+    _dense,
+    _dense_init,
+    _ln_apply,
+    _ln_init,
+    check_pos_capacity,
+    patchify,
+)
 from tpu_dist.parallel.expert import MoE
 
 
@@ -94,11 +101,7 @@ class ViTMoEDef:
         }
 
     def patchify(self, x):
-        b, h, w, c = x.shape
-        ph = pw = self.patch_size
-        x = x.reshape(b, h // ph, ph, w // pw, pw, c)
-        x = x.transpose(0, 1, 3, 2, 4, 5)
-        return x.reshape(b, (h // ph) * (w // pw), ph * pw * c)
+        return patchify(x, self.patch_size)
 
     def apply(
         self,
@@ -118,6 +121,7 @@ class ViTMoEDef:
         del axis_name
         tokens = self.patchify(x)
         t = _dense(params["patch"], tokens)
+        check_pos_capacity(t.shape[1], params["pos"], self.image_size, self.patch_size)
         t = t + params["pos"][: t.shape[1]].astype(t.dtype)[None]
 
         h_dim = self.dim // self.heads

@@ -195,8 +195,9 @@ class Trainer:
                 f"batch_size {cfg.batch_size} must divide over {self.n_data} "
                 f"data-parallel devices"
             )
-        per_device = cfg.batch_size // self.n_data
-        if per_device % cfg.grad_accu_steps:
+        # under ep>1 the batch shards over ALL devices (expert axis carries data)
+        per_device = cfg.batch_size // (self.n_devices if cfg.ep > 1 else self.n_data)
+        if per_device == 0 or per_device % cfg.grad_accu_steps:
             raise ValueError(
                 f"per-device batch {per_device} must divide by grad_accu_steps="
                 f"{cfg.grad_accu_steps}"
