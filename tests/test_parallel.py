@@ -124,7 +124,9 @@ def test_pipeline_matches_sequential():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
 
 
-def test_pipeline_differentiable():
+def test_pipeline_differentiable_per_device():
+    """Production convention: grads taken INSIDE shard_map (per-device loss
+    replica, as make_train_step does) match the sequential reference."""
     n_stages, n_micro, d = 4, 4, 6
     mesh = mesh_lib.device_mesh([n_stages], ["pipe"], jax.devices()[:n_stages])
     rng = np.random.default_rng(1)
@@ -134,15 +136,17 @@ def test_pipeline_differentiable():
     def stage_fn(w, h):
         return jnp.tanh(h @ w)
 
-    def loss_pp(ws):
-        out = shard_map(
-            lambda w_l, xm: pipeline_apply(stage_fn, w_l[0], xm, "pipe", n_stages),
-            mesh=mesh,
-            in_specs=(P("pipe"), P()),
-            out_specs=P(),
-            check_vma=False,
-        )(ws, x)
-        return jnp.sum(out ** 2)
+    def local(w_l, xm):
+        def lf(w_l):
+            out = pipeline_apply(stage_fn, w_l[0], xm, "pipe", n_stages)
+            return jnp.sum(out ** 2)
+
+        return jax.grad(lf)(w_l)
+
+    g_pp = shard_map(
+        local, mesh=mesh, in_specs=(P("pipe"), P()), out_specs=P("pipe"),
+        check_vma=False,
+    )(ws, x)
 
     def loss_seq(ws):
         h = x
@@ -150,6 +154,5 @@ def test_pipeline_differentiable():
             h = jnp.tanh(h @ ws[s])
         return jnp.sum(h ** 2)
 
-    g_pp = jax.grad(loss_pp)(ws)
     g_seq = jax.grad(loss_seq)(ws)
     np.testing.assert_allclose(np.asarray(g_pp), np.asarray(g_seq), rtol=1e-3, atol=1e-4)

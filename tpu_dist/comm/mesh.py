@@ -34,6 +34,7 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
+PIPE_AXIS = "pipe"
 
 
 def initialize_distributed(

@@ -63,6 +63,8 @@ class TrainConfig:
                                    # model must support tp_axis (ViT)
     ep: int = 1                    # expert-parallel ways (DPxEP mesh);
                                    # model must support ep_axis (ViT-MoE)
+    pp: int = 1                    # pipeline-parallel stages (DPxPP mesh);
+                                   # model must support pp_axis (ViT-PP)
 
     # -- checkpoint / eval cadence -----------------------------------------
     ckpt_dir: Optional[str] = None
@@ -124,6 +126,7 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--sp", type=int, default=d.sp)
     p.add_argument("--tp", type=int, default=d.tp)
     p.add_argument("--ep", type=int, default=d.ep)
+    p.add_argument("--pp", type=int, default=d.pp)
     p.add_argument("--ckpt_dir", type=str, default=None)
     p.add_argument("--keep_last_ckpts", type=int, default=None)
     p.add_argument("--resume", action="store_true")

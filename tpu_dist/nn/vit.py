@@ -62,6 +62,21 @@ def patchify(x, patch_size: int):
     return x.reshape(b, (h // ph) * (w // pw), ph * pw * c)
 
 
+def block_forward(blk, t, heads: int):
+    """One standard (full-attention) transformer block on [B, S, D].
+    Shared by ViTDef's sequential path and the pipeline-parallel wrapper."""
+    b, s, dim = t.shape
+    h_dim = dim // heads
+    y = _ln_apply(blk["ln1"], t)
+    qkv = _dense(blk["qkv"], y).reshape(b, s, heads, 3, h_dim)
+    q, k, v = (qkv[:, :, :, i, :] for i in range(3))
+    o = attn_lib.full_attention(q, k, v)
+    t = t + _dense(blk["proj"], o.reshape(b, s, dim))
+    y = _ln_apply(blk["ln2"], t)
+    y = jax.nn.gelu(_dense(blk["mlp1"], y))
+    return t + _dense(blk["mlp2"], y)
+
+
 def check_pos_capacity(n_tokens: int, pos_table, image_size: int, patch_size: int):
     """Loud error when the input has more patch tokens than the positional
     table (smaller inputs are fine — they use the leading positions)."""

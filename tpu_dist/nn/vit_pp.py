@@ -1,0 +1,152 @@
+"""Pipeline-parallel ViT: transformer blocks sharded into stages over a
+``pipe`` mesh axis, microbatches streamed GPipe-style.
+
+No reference counterpart (SURVEY §2.3: no PP anywhere). Design: the
+embed/positional/head layers are small and stay replicated (computed on
+every device); only the uniform transformer-block stack is pipelined —
+each device owns ``depth / n_stages`` consecutive blocks, held as STACKED
+arrays (leading block dim) so one ``P('pipe')`` spec shards them. A stage
+runs its blocks with a ``lax.scan``; stage handoff is
+``tpu_dist.parallel.pipeline.pipeline_apply``'s ``ppermute`` ring.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_dist.nn.vit import (
+    ViTDef,
+    _dense,
+    _ln_apply,
+    block_forward,
+    check_pos_capacity,
+    patchify,
+)
+from tpu_dist.parallel.pipeline import pipeline_apply
+
+
+@dataclass(frozen=True)
+class ViTPipelineDef:
+    """Same architecture as :class:`ViTDef` with blocks stored STACKED:
+    every ``params["blocks"]`` leaf has a leading ``depth`` dim."""
+
+    image_size: int = 32
+    patch_size: int = 4
+    dim: int = 64
+    depth: int = 4
+    heads: int = 4
+    mlp_ratio: int = 4
+    num_classes: int = 10
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    def _vit(self) -> ViTDef:
+        return ViTDef(
+            image_size=self.image_size, patch_size=self.patch_size, dim=self.dim,
+            depth=self.depth, heads=self.heads, mlp_ratio=self.mlp_ratio,
+            num_classes=self.num_classes,
+        )
+
+    def init(self, key, dtype=jnp.float32):
+        params, state = self._vit().init(key, dtype)
+        blocks = params.pop("blocks")  # list of per-block dicts → stacked
+        params["blocks"] = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves), *blocks
+        )
+        return params, state
+
+    def pp_param_specs(self, axis: str):
+        """Blocks sharded on their stacked leading dim; rest replicated."""
+        from jax.sharding import PartitionSpec as P  # noqa: PLC0415
+
+        return {
+            "patch": {"w": P(), "b": P()},
+            "pos": P(),
+            "blocks": jax.tree_util.tree_map(
+                lambda _: P(axis), self._block_leaf_template()
+            ),
+            "ln_f": {"scale": P(), "bias": P()},
+            "head": {"w": P(), "b": P()},
+        }
+
+    def _block_leaf_template(self):
+        return {
+            "ln1": {"scale": 0, "bias": 0},
+            "qkv": {"w": 0, "b": 0},
+            "proj": {"w": 0, "b": 0},
+            "ln2": {"scale": 0, "bias": 0},
+            "mlp1": {"w": 0, "b": 0},
+            "mlp2": {"w": 0, "b": 0},
+        }
+
+    def patchify(self, x):
+        return patchify(x, self.patch_size)
+
+    # -- forward -------------------------------------------------------------
+
+    def _embed(self, params, x):
+        t = _dense(params["patch"], self.patchify(x))
+        check_pos_capacity(t.shape[1], params["pos"], self.image_size, self.patch_size)
+        return t + params["pos"][: t.shape[1]].astype(t.dtype)[None]
+
+    def _stage_scan(self, stage_blocks, t):
+        """Run this stage's stacked blocks sequentially."""
+
+        def body(h, blk):
+            return block_forward(blk, h, self.heads), None
+
+        out, _ = lax.scan(body, t, stage_blocks)
+        return out
+
+    def _finish(self, params, t):
+        t = _ln_apply(params["ln_f"], t)
+        return _dense(params["head"], t.mean(axis=1))
+
+    def apply(
+        self,
+        params,
+        state,
+        x,
+        *,
+        train: bool = False,
+        axis_name: Optional[str] = None,  # contract parity (no BN)
+        pp_axis: Optional[str] = None,
+        n_microbatches: int = 0,
+    ):
+        """Without ``pp_axis``: sequential scan over all blocks (reference
+        semantics). With ``pp_axis``: ``params["blocks"]`` arrives holding
+        only THIS stage's blocks; the batch is split into ``n_microbatches``
+        (default: the stage count) and streamed through the ring.
+        """
+        del axis_name
+        t = self._embed(params, x)
+        if pp_axis is None:
+            t = self._stage_scan(params["blocks"], t)
+            return self._finish(params, t), state
+
+        n_stages = lax.axis_size(pp_axis)
+        m = n_microbatches or n_stages
+        b = t.shape[0]
+        if b % m:
+            raise ValueError(f"batch {b} must divide into {m} microbatches")
+        micro = t.reshape(m, b // m, *t.shape[1:])
+        outs = pipeline_apply(
+            lambda blocks, h: self._stage_scan(blocks, h),
+            params["blocks"],
+            micro,
+            pp_axis,
+            n_stages,
+        )
+        t = outs.reshape(b, *t.shape[1:])
+        return self._finish(params, t), state
+
+
+def vit_pp_tiny(num_classes: int = 10, image_size: int = 32) -> ViTPipelineDef:
+    return ViTPipelineDef(image_size=image_size, num_classes=num_classes)

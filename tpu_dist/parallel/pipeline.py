@@ -8,9 +8,16 @@ ticks. At each tick a device runs its stage on the activation it holds and
 passes the result to the next stage via ``lax.ppermute`` (nearest-neighbor
 ICI). Bubble fraction is the usual (S-1)/(M+S-1).
 
-Forward-only building block (inference / activation serving); training
-composes it under ``jax.grad`` — XLA differentiates through ``ppermute``
-(reverse permutation), so a pipelined loss is differentiable as-is.
+Differentiation: stage handoffs (``ppermute``) transpose exactly; the
+microbatch ingestion and final result broadcast are wrapped in the
+conjugate custom-VJP ops from :func:`tpu_dist.parallel.tensor.tp_ops`
+(identity-fwd/psum-bwd on the input, psum-fwd/identity-bwd on the output).
+GRADIENT CONVENTION: correctness is defined for PER-DEVICE loss-replica
+differentiation — ``jax.grad`` taken INSIDE ``shard_map``, each device
+differentiating its own copy of the replicated loss. That is what
+``make_train_step`` does, and what the equivalence tests pin. Cotangents
+arriving from OUTSIDE the ``shard_map`` are scaled 1/n by the out-spec
+machinery — scale by the stage count if you differentiate that way.
 """
 
 from __future__ import annotations
@@ -41,6 +48,10 @@ def pipeline_apply(
 
     Returns [M, B_micro, ...] outputs (valid on every device).
     """
+    from tpu_dist.parallel.tensor import tp_ops  # noqa: PLC0415
+
+    copy_to_pipe, reduce_from_pipe = tp_ops(axis)
+    x_micro = copy_to_pipe(x_micro)
     M = x_micro.shape[0]
     my = lax.axis_index(axis)
     n = n_stages
@@ -70,5 +81,5 @@ def pipeline_apply(
     outs0 = jnp.zeros_like(x_micro)
     (_, outs), _ = lax.scan(tick, (h0, outs0), jnp.arange(total))
     # outs is only valid on the last stage; broadcast it to every device
-    outs = lax.psum(jnp.where(my == n - 1, outs, jnp.zeros_like(outs)), axis)
+    outs = reduce_from_pipe(jnp.where(my == n - 1, outs, jnp.zeros_like(outs)))
     return outs

@@ -59,6 +59,7 @@ def make_train_step(
     seq_axis: str | None = None,
     tp_axis: str | None = None,
     ep_axis: str | None = None,
+    pp_axis: str | None = None,
     param_specs=None,
 ):
     """Build ``step(state, images, labels, lr) -> (state, metrics)``.
@@ -107,6 +108,13 @@ def make_train_step(
                 "ep_axis is incompatible with shard_weight_update / "
                 "grad_clip_norm / seq_axis / tp_axis for now"
             )
+    if pp_axis is not None:
+        if param_specs is None:
+            raise ValueError("pp_axis requires param_specs (per-leaf shardings)")
+        if shard_weight_update or grad_clip_norm > 0.0 or seq_axis or tp_axis or ep_axis:
+            raise ValueError(
+                "pp_axis is incompatible with other parallel modes for now"
+            )
     # the expert axis doubles as a data axis outside the MoE: batch shards
     # over both, metrics/loss reduce over both
     batch_axes = (axis, ep_axis) if ep_axis is not None else axis
@@ -122,6 +130,8 @@ def make_train_step(
             kw["tp_axis"] = tp_axis
         if ep_axis is not None:
             kw["ep_axis"] = ep_axis
+        if pp_axis is not None:
+            kw["pp_axis"] = pp_axis
         logits, new_bn = model_apply(p, bn_state, x, train=True, axis_name=bn_axis, **kw)
         loss = F.cross_entropy(logits, labels, label_smoothing=label_smoothing)
         return loss, (new_bn, logits)
@@ -291,6 +301,7 @@ def make_eval_step(
     axis=mesh_lib.DATA_AXIS,
     tp_axis: str | None = None,
     ep_axis: str | None = None,
+    pp_axis: str | None = None,
     param_specs=None,
 ):
     """Build ``eval_step(state, images, labels, mask) -> sums``.
@@ -315,6 +326,8 @@ def make_eval_step(
             kw["tp_axis"] = tp_axis
         if ep_axis is not None:
             kw["ep_axis"] = ep_axis
+        if pp_axis is not None:
+            kw["pp_axis"] = pp_axis
         logits, _ = model_apply(p, state.bn_state, x, train=False, axis_name=None, **kw)
         nll = F.cross_entropy(logits, labels, reduction="none")
         maxk_hits = _masked_topk(logits, labels, mask)

@@ -76,6 +76,10 @@ def build_model(cfg: TrainConfig):
         from tpu_dist.nn.vit_moe import vit_moe_tiny  # noqa: PLC0415
 
         _MODELS.setdefault("vit_moe_tiny", vit_moe_tiny)
+
+        from tpu_dist.nn.vit_pp import vit_pp_tiny  # noqa: PLC0415
+
+        _MODELS.setdefault("vit_pp_tiny", vit_pp_tiny)
     except ImportError:
         pass
     if cfg.model not in _MODELS:
@@ -91,20 +95,21 @@ class Trainer:
             num_processes=cfg.num_processes,
             process_id=cfg.process_id,
         )
-        if sum(w > 1 for w in (cfg.sp, cfg.tp, cfg.ep)) > 1:
-            raise ValueError("sp, tp and ep cannot be combined yet")
+        if sum(w > 1 for w in (cfg.sp, cfg.tp, cfg.ep, cfg.pp)) > 1:
+            raise ValueError("sp, tp, ep and pp cannot be combined yet")
         if mesh is not None:
             self.mesh = mesh
-        elif cfg.sp > 1 or cfg.tp > 1 or cfg.ep > 1:
-            ways = max(cfg.sp, cfg.tp, cfg.ep)
+        elif cfg.sp > 1 or cfg.tp > 1 or cfg.ep > 1 or cfg.pp > 1:
+            ways = max(cfg.sp, cfg.tp, cfg.ep, cfg.pp)
             second = (
                 mesh_lib.SEQ_AXIS if cfg.sp > 1
                 else mesh_lib.MODEL_AXIS if cfg.tp > 1
-                else mesh_lib.EXPERT_AXIS
+                else mesh_lib.EXPERT_AXIS if cfg.ep > 1
+                else mesh_lib.PIPE_AXIS
             )
             n = len(jax.devices())
             if n % ways:
-                raise ValueError(f"{n} devices not divisible by sp/tp/ep={ways}")
+                raise ValueError(f"{n} devices not divisible by sp/tp/ep/pp={ways}")
             self.mesh = mesh_lib.device_mesh(
                 [n // ways, ways], [mesh_lib.DATA_AXIS, second]
             )
@@ -173,6 +178,28 @@ class Trainer:
                     f"all {self.n_devices} devices (the expert axis carries data)"
                 )
             self._param_specs = self.model.ep_param_specs(mesh_lib.EXPERT_AXIS)
+        if cfg.pp > 1:
+            import inspect  # noqa: PLC0415
+
+            if "pp_axis" not in inspect.signature(self.model.apply).parameters:
+                raise ValueError(
+                    f"model {cfg.model!r} does not support pipeline parallelism "
+                    f"(no pp_axis in apply); use vit_pp_* or pp=1"
+                )
+            depth = getattr(self.model, "depth", None)
+            if depth is not None and depth % cfg.pp:
+                raise ValueError(f"depth {depth} not divisible by pp={cfg.pp} stages")
+            if cfg.fused_epoch or cfg.shard_weight_update or cfg.grad_clip_norm > 0:
+                raise ValueError(
+                    "pp > 1 is incompatible with fused_epoch / zero1 / grad_clip_norm"
+                )
+            per_dev_batch = cfg.batch_size // max(1, self.n_data)
+            if per_dev_batch % cfg.pp:
+                raise ValueError(
+                    f"per-data-shard batch {per_dev_batch} must divide into "
+                    f"{cfg.pp} microbatches"
+                )
+            self._param_specs = self.model.pp_param_specs(mesh_lib.PIPE_AXIS)
 
         # -- data ------------------------------------------------------------
         if cfg.dataset == "synthetic":
@@ -272,12 +299,14 @@ class Trainer:
             seq_axis=mesh_lib.SEQ_AXIS if cfg.sp > 1 else None,
             tp_axis=mesh_lib.MODEL_AXIS if cfg.tp > 1 else None,
             ep_axis=mesh_lib.EXPERT_AXIS if cfg.ep > 1 else None,
+            pp_axis=mesh_lib.PIPE_AXIS if cfg.pp > 1 else None,
             param_specs=self._param_specs,
         )
         self.eval_step = make_eval_step(
             self.model.apply, self.mesh, compute_dtype=compute_dtype, axis=eval_axes,
             tp_axis=mesh_lib.MODEL_AXIS if cfg.tp > 1 else None,
             ep_axis=mesh_lib.EXPERT_AXIS if cfg.ep > 1 else None,
+            pp_axis=mesh_lib.PIPE_AXIS if cfg.pp > 1 else None,
             param_specs=self._param_specs,
         )
 
