@@ -1,0 +1,29 @@
+"""Smoke matrix: common flag combinations must train one finite step."""
+
+import numpy as np
+import pytest
+
+from tpu_dist.config import TrainConfig
+from tpu_dist.train.trainer import Trainer, register_model
+from tests.helpers import tiny_resnet
+
+register_model("tiny_resnet_m", lambda num_classes=10: tiny_resnet(num_classes))
+
+COMBOS = [
+    dict(bf16=True, grad_accu_steps=2),
+    dict(bf16=True, shard_weight_update=True),
+    dict(sync_bn=False, grad_accu_steps=2, label_smoothing=0.1),
+    dict(bf16=True, grad_clip_norm=1.0, lr_schedule="cosine", warmup_epochs=1),
+    dict(fused_optimizer=True, bf16=True),
+]
+
+
+@pytest.mark.parametrize("combo", COMBOS, ids=[",".join(c) for c in COMBOS])
+def test_flag_combo_trains(combo):
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet_m", num_classes=10,
+        batch_size=64, epochs=1, steps_per_epoch=2, log_every=1,
+        eval_every=0, lr=0.05, synthetic_n=640, **combo,
+    )
+    out = Trainer(cfg).train_epoch(0)
+    assert np.isfinite(out["loss"]), combo

@@ -119,6 +119,7 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--no_nan_guard", dest="nan_guard", action="store_false")
     p.add_argument("--dataset", type=str, default=d.dataset)
     p.add_argument("--data_dir", type=str, default=d.data_dir)
+    p.add_argument("--synthetic_n", type=int, default=d.synthetic_n)
     p.add_argument("--model", type=str, default=d.model)
     p.add_argument("--num_classes", type=int, default=d.num_classes)
     p.add_argument("--num_processes", type=int, default=None)
