@@ -24,3 +24,5 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# persistent compile cache: repeat suite runs skip most XLA compiles
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache_tests")
