@@ -18,3 +18,21 @@ inside one compiled step (reference: ``distributed.py:60``,
 __version__ = "0.1.0"
 
 from tpu_dist.comm import mesh as mesh  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy top-level conveniences (avoid importing jax-heavy modules on
+    # plain `import tpu_dist`)
+    if name == "Trainer":
+        from tpu_dist.train.trainer import Trainer
+
+        return Trainer
+    if name == "TrainConfig":
+        from tpu_dist.config import TrainConfig
+
+        return TrainConfig
+    if name == "register_model":
+        from tpu_dist.train.trainer import register_model
+
+        return register_model
+    raise AttributeError(f"module 'tpu_dist' has no attribute {name!r}")
