@@ -2,7 +2,6 @@
 2×4 mesh with ring attention ≡ single-device training."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from tpu_dist.comm import mesh as mesh_lib
