@@ -65,6 +65,26 @@ def test_dp_tp_training_matches_single_device():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5)
 
 
+def test_tp_forward_parity():
+    """TP-sharded forward ≡ dense forward (eval-path insurance)."""
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    model = _model()
+    params, s = model.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3), jnp.float32)
+    ref, _ = model.apply(params, s, x)
+
+    mesh = mesh_lib.device_mesh([4], ["model"], jax.devices()[:4])
+    specs = model.tp_param_specs("model")
+    out = shard_map(
+        lambda p, xl: model.apply(p, {}, xl, tp_axis="model")[0],
+        mesh=mesh, in_specs=(specs, P()), out_specs=P(), check_vma=False,
+    )(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
 def test_trainer_tp_e2e_with_eval_and_resume(tmp_path):
     cfg = TrainConfig(
         dataset="synthetic", model="vit_tiny", num_classes=10, batch_size=16,
