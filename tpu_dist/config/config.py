@@ -81,6 +81,7 @@ class TrainConfig:
     shard_weight_update: bool = False  # ZeRO-1 weight-update sharding
                                        # (arXiv:2004.13336; train/step.py)
     fused_optimizer: bool = False  # Pallas fused SGD kernel (ops/fused_sgd.py)
+    remat: bool = False            # jax.checkpoint the forward (less memory)
 
     # -- bench / smoke / debug ---------------------------------------------
     steps_per_epoch: Optional[int] = None  # cap steps (smoke tests / benches)
@@ -115,6 +116,7 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--fused_epoch", action="store_true")
     p.add_argument("--shard_weight_update", "--zero1", action="store_true")
     p.add_argument("--fused_optimizer", action="store_true")
+    p.add_argument("--remat", action="store_true")
     p.add_argument("--no_sync_bn", dest="sync_bn", action="store_false")
     p.add_argument("--no_nan_guard", dest="nan_guard", action="store_false")
     p.add_argument("--dataset", type=str, default=d.dataset)

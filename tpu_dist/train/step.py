@@ -61,6 +61,7 @@ def make_train_step(
     ep_axis: str | None = None,
     pp_axis: str | None = None,
     param_specs=None,
+    remat: bool = False,
 ):
     """Build ``step(state, images, labels, lr) -> (state, metrics)``.
 
@@ -146,6 +147,12 @@ def make_train_step(
         scale = jnp.minimum(1.0, grad_clip_norm / jnp.maximum(norm, 1e-12))
         return jax.tree_util.tree_map(lambda g: g * scale, grads)
 
+    if remat:
+        # rematerialize the forward during the backward: activations are
+        # recomputed instead of stored, trading ~33% extra FLOPs for O(depth)
+        # less activation memory — the standard TPU lever for bigger batches.
+        # Numerics identical (tests/test_remat.py).
+        loss_fn = jax.checkpoint(loss_fn)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     def local_grads(params, bn_state, images, labels):

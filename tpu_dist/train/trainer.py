@@ -301,6 +301,7 @@ class Trainer:
             ep_axis=mesh_lib.EXPERT_AXIS if cfg.ep > 1 else None,
             pp_axis=mesh_lib.PIPE_AXIS if cfg.pp > 1 else None,
             param_specs=self._param_specs,
+            remat=cfg.remat,
         )
         self.eval_step = make_eval_step(
             self.model.apply, self.mesh, compute_dtype=compute_dtype, axis=eval_axes,
