@@ -1,0 +1,57 @@
+"""Property-based checks of the DistributedSampler invariants."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from tpu_dist.data.sampler import DistributedSampler
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    n=st.integers(1, 400),
+    shards=st.integers(1, 9),
+    seed=st.integers(0, 1000),
+    epoch=st.integers(0, 5),
+    drop_last=st.booleans(),
+)
+def test_partition_invariants(n, shards, seed, epoch, drop_last):
+    samplers = [
+        DistributedSampler(n, shards, i, shuffle=True, seed=seed, drop_last=drop_last)
+        for i in range(shards)
+    ]
+    for s in samplers:
+        s.set_epoch(epoch)
+    idx = [s.indices() for s in samplers]
+    masks = [s.pad_mask() for s in samplers]
+
+    # equal shard sizes, consistent with len()
+    sizes = {len(i) for i in idx}
+    assert len(sizes) == 1
+    assert sizes.pop() == len(samplers[0])
+
+    if drop_last:
+        # no duplicates anywhere; every index is real
+        allidx = np.concatenate(idx) if idx[0].size else np.array([], int)
+        assert len(set(allidx.tolist())) == len(allidx)
+        assert all(m.all() for m in masks)
+    else:
+        # real (mask=True) positions cover every example exactly once
+        real = np.concatenate(
+            [i[m] for i, m in zip(idx, masks)]
+        ) if idx[0].size else np.array([], int)
+        assert sorted(real.tolist()) == list(range(n))
+
+    # indices always in range
+    for i in idx:
+        if i.size:
+            assert i.min() >= 0 and i.max() < n
+
+
+@settings(max_examples=30, deadline=None)
+@given(n=st.integers(2, 300), shards=st.integers(1, 8), seed=st.integers(0, 100))
+def test_epoch_determinism(n, shards, seed):
+    a = DistributedSampler(n, shards, 0, seed=seed)
+    b = DistributedSampler(n, shards, 0, seed=seed)
+    a.set_epoch(3)
+    b.set_epoch(3)
+    np.testing.assert_array_equal(a.indices(), b.indices())

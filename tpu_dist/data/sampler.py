@@ -61,8 +61,10 @@ class DistributedSampler:
         if self.drop_last:
             order = order[: self.total_size]
         elif len(order) < self.total_size:
-            # wrap-around padding, same policy as torch's sampler
-            order = np.concatenate([order, order[: self.total_size - len(order)]])
+            # wrap-around padding, same policy as torch's sampler; tile so
+            # even num_shards > num_examples pads fully
+            reps = -(-self.total_size // len(order))
+            order = np.tile(order, reps)[: self.total_size]
         return order[self.shard_id :: self.num_shards]
 
     def pad_mask(self) -> np.ndarray:
