@@ -79,12 +79,39 @@ def test_trainer_sp_rejects_non_sp_model():
         Trainer(TrainConfig(dataset="synthetic", model="resnet18", sp=4, synthetic_n=512))
 
 
-def test_seq_axis_with_zero1_rejected():
-    import pytest
+def test_seq_axis_composes_with_zero1():
+    """SP + ZeRO-1 weight-update sharding ≡ plain SP."""
+    import jax.numpy as jnp
+
+    from tpu_dist.train.step import init_sharded_opt_state
 
     model = _model()
+    opt = SGD()
     mesh2d = mesh_lib.device_mesh([2, 4], ["data", "seq"])
-    with pytest.raises(ValueError, match="seq_axis"):
-        make_train_step(
-            model.apply, SGD(), mesh2d, seq_axis="seq", shard_weight_update=True
-        )
+
+    s_plain = _state(model, mesh2d)
+    params, s = model.init(jax.random.PRNGKey(0))
+    s_z1 = TrainState(
+        params=jax.device_put(params, mesh_lib.replicated(mesh2d)),
+        bn_state=jax.device_put(s, mesh_lib.replicated(mesh2d)),
+        opt_state=init_sharded_opt_state(params, mesh2d),
+        step=jax.device_put(jnp.zeros((), jnp.int32), mesh_lib.replicated(mesh2d)),
+    )
+    step_plain = make_train_step(
+        model.apply, opt, mesh2d, sync_bn=False, donate=False, seq_axis="seq"
+    )
+    step_z1 = make_train_step(
+        model.apply, opt, mesh2d, sync_bn=False, donate=False, seq_axis="seq",
+        shard_weight_update=True,
+    )
+    rng = np.random.default_rng(0)
+    for _ in range(2):
+        x = mesh_lib.shard_batch(mesh2d, rng.normal(size=(8, 32, 32, 3)).astype(np.float32))
+        y = mesh_lib.shard_batch(mesh2d, rng.integers(0, 5, 8).astype(np.int32))
+        s_plain, mp = step_plain(s_plain, x, y, 0.05)
+        s_z1, mz = step_z1(s_z1, x, y, 0.05)
+    np.testing.assert_allclose(float(mp["loss"]), float(mz["loss"]), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_plain.params), jax.tree_util.tree_leaves(s_z1.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)

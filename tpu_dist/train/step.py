@@ -86,13 +86,11 @@ def make_train_step(
     model (e.g. ViT) slices its own token chunk and runs ring attention
     over the axis. Parameter gradients are ``pmean``-ed over ``seq_axis``
     on top of the ``pmean`` over the data axis (each shard differentiates a
-    full loss replica). Incompatible with ``shard_weight_update`` and
-    SyncBN models for now.
+    full loss replica). Composes with ``shard_weight_update`` (the seq
+    pmean happens before the data-axis reduce-scatter).
     """
     K = int(grad_accum_steps)
     n_axis = int(mesh.shape[axis])
-    if seq_axis is not None and shard_weight_update:
-        raise ValueError("seq_axis + shard_weight_update not supported together")
     if tp_axis is not None:
         if param_specs is None:
             raise ValueError("tp_axis requires param_specs (per-leaf shardings)")
@@ -247,6 +245,10 @@ def make_train_step(
         momentum → all-gather params (arXiv:2004.13336)."""
         from jax.flatten_util import ravel_pytree  # noqa: PLC0415
 
+        if seq_axis is not None:
+            # same correction as the plain path: each seq shard holds a
+            # full-loss-replica gradient, mean over the axis recovers truth
+            grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, seq_axis), grads)
         flat_g, _ = ravel_pytree(grads)
         flat_p, unravel = ravel_pytree(state.params)
         L = flat_g.shape[0]
