@@ -27,3 +27,24 @@ def test_flag_combo_trains(combo):
     )
     out = Trainer(cfg).train_epoch(0)
     assert np.isfinite(out["loss"]), combo
+
+
+PARALLEL_COMBOS = [
+    dict(model="vit_tiny", sp=4, grad_accu_steps=2, sync_bn=False, batch_size=32),
+    dict(model="vit_tiny", tp=4, grad_accu_steps=2, sync_bn=False, batch_size=32),
+    dict(model="vit_moe_tiny", ep=4, grad_accu_steps=2, sync_bn=False, batch_size=32),
+    dict(model="vit_tiny", sp=4, bf16=True, remat=True, sync_bn=False, batch_size=32),
+]
+
+
+@pytest.mark.parametrize(
+    "combo", PARALLEL_COMBOS,
+    ids=["sp+ga", "tp+ga", "ep+ga", "sp+bf16+remat"],
+)
+def test_parallel_axes_compose_with_accum(combo):
+    cfg = TrainConfig(
+        dataset="synthetic", num_classes=10, epochs=1, steps_per_epoch=2,
+        log_every=1, eval_every=0, lr=0.05, synthetic_n=320, **combo,
+    )
+    out = Trainer(cfg).train_epoch(0)
+    assert np.isfinite(out["loss"]), combo
