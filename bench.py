@@ -67,7 +67,7 @@ CONFIGS = {
 }
 
 
-def run(cfg: BenchConfig, steps: int, warmup: int) -> dict:
+def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -82,7 +82,12 @@ def run(cfg: BenchConfig, steps: int, warmup: int) -> dict:
         "resnet18": resnet18, "resnet34": resnet34, "resnet50": resnet50,
         "vit_b16": lambda num_classes: vit_b16(num_classes, cfg.image_size),
     }
-    mesh = mesh_lib.data_parallel_mesh()
+    if n_devices is None:
+        mesh = mesh_lib.data_parallel_mesh()
+    else:
+        mesh = mesh_lib.device_mesh(
+            [n_devices], [mesh_lib.DATA_AXIS], jax.devices()[:n_devices]
+        )
     n_dev = int(mesh.devices.size)
     batch = cfg.global_batch
     if batch % (n_dev * cfg.grad_accum):
@@ -222,6 +227,12 @@ def main() -> None:
         "--init_timeout", type=float,
         default=float(os.environ.get("BENCH_INIT_TIMEOUT", "600")),
     )
+    p.add_argument(
+        "--scaling", action="store_true",
+        help="run the config on 1,2,4,...,N-device meshes and report "
+             "scaling efficiency (BASELINE's 1→8→32 chip metric; limited "
+             "by visible devices)",
+    )
     args = p.parse_args()
 
     # persistent XLA compile cache: repeat bench invocations skip the
@@ -231,7 +242,17 @@ def main() -> None:
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
 
     _guarded_backend_init(args.init_timeout)
-    if args.all:
+    if args.scaling:
+        n = len(jax.devices())
+        sizes = [s for s in (1, 2, 4, 8, 16, 32) if s <= n]
+        base = None
+        for s in sizes:
+            out = run(CONFIGS[args.config], args.steps, args.warmup, n_devices=s)
+            if base is None:
+                base = out["value"]
+            out["scaling_efficiency"] = round(out["value"] / (base * s), 3)
+            print(json.dumps(out))
+    elif args.all:
         for name in sorted(CONFIGS):
             print(json.dumps(run(CONFIGS[name], args.steps, args.warmup)))
     else:
