@@ -147,3 +147,71 @@ def make_fused_epoch(
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_fused_eval(
+    model_apply: Callable,
+    mesh: Mesh,
+    *,
+    batch_per_device: int,
+    compute_dtype=jnp.bfloat16,
+    axis: str = mesh_lib.DATA_AXIS,
+    mean: np.ndarray = CIFAR100_MEAN,
+    std: np.ndarray = CIFAR100_STD,
+):
+    """Whole-test-set evaluation as ONE jit call over device-resident data.
+
+    ``eval(state, images_u8, labels) -> {loss, top1, top5, count}`` global
+    sums — the fused counterpart of ``make_eval_step``: the uint8 test set
+    lives sharded in HBM (see :func:`put_dataset_on_device`), a ``lax.scan``
+    sweeps it in per-device batches, normalization happens on device, and
+    padding slots are masked (exact counts, no double-count — same
+    guarantee as the streaming evaluator). Padding convention: label < 0
+    marks a padding example (use it to round the dataset up to a multiple
+    of the device count before :func:`put_dataset_on_device`); the
+    per-device scan tail is padded the same way internally.
+    """
+    mean_c = jnp.asarray(mean, jnp.float32)
+    std_inv_c = jnp.asarray(1.0 / std, jnp.float32)
+
+    def eval_local(state: TrainState, images_u8, labels):
+        n_loc = images_u8.shape[0]
+        steps = -(-n_loc // batch_per_device)
+        pad = steps * batch_per_device - n_loc
+        imgs = jnp.pad(images_u8, ((0, pad), (0, 0), (0, 0), (0, 0)))
+        lbls = jnp.pad(labels, (0, pad), constant_values=-1)
+        p = jax.tree_util.tree_map(lambda t: t.astype(compute_dtype), state.params)
+
+        def body(acc, i):
+            sl = lambda t: lax.dynamic_slice_in_dim(t, i * batch_per_device, batch_per_device)
+            x = (sl(imgs).astype(jnp.float32) / 255.0 - mean_c) * std_inv_c
+            logits, _ = model_apply(
+                p, state.bn_state, x.astype(compute_dtype), train=False, axis_name=None
+            )
+            y = sl(lbls)
+            m = (y >= 0).astype(jnp.float32)
+            y = jnp.maximum(y, 0)  # safe index for the masked loss
+            nll = F.cross_entropy(logits, y, reduction="none")
+            maxk = min(5, logits.shape[-1])
+            _, pred = lax.top_k(logits.astype(jnp.float32), maxk)
+            hits = (pred == y[:, None]).astype(jnp.float32) * m[:, None]
+            acc = {
+                "loss": acc["loss"] + jnp.sum(nll * m),
+                "top1": acc["top1"] + jnp.sum(hits[:, :1]),
+                "top5": acc["top5"] + jnp.sum(hits[:, :maxk]),
+                "count": acc["count"] + jnp.sum(m),
+            }
+            return acc, None
+
+        zero = {k: jnp.zeros((), jnp.float32) for k in ("loss", "top1", "top5", "count")}
+        sums, _ = lax.scan(body, zero, jnp.arange(steps))
+        return jax.tree_util.tree_map(lambda t: lax.psum(t, axis), sums)
+
+    sharded = shard_map(
+        eval_local,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

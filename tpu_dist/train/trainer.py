@@ -313,13 +313,30 @@ class Trainer:
 
         self._fused_runner = None
         if cfg.fused_epoch:
-            from tpu_dist.train.epoch import make_fused_epoch, put_dataset_on_device  # noqa: PLC0415
+            from tpu_dist.train.epoch import (  # noqa: PLC0415
+                make_fused_epoch,
+                make_fused_eval,
+                put_dataset_on_device,
+            )
 
             self._fused_data = put_dataset_on_device(self.mesh, *self.train_data)
             self._fused_runner = make_fused_epoch(
                 self.model.apply, self.optimizer, self.mesh,
                 batch_per_device=cfg.batch_size // self.n_devices,
                 sync_bn=cfg.sync_bn, compute_dtype=compute_dtype,
+            )
+            # round the test set UP to a device multiple with label=-1
+            # padding so fused eval counts every real example exactly once
+            ti, tl = self.test_data
+            pad = (-len(tl)) % self.n_devices
+            if pad:
+                ti = np.concatenate([ti, np.zeros((pad,) + ti.shape[1:], ti.dtype)])
+                tl = np.concatenate([tl, np.full(pad, -1, tl.dtype)])
+            self._fused_test_data = put_dataset_on_device(self.mesh, ti, tl)
+            self._fused_eval = make_fused_eval(
+                self.model.apply, self.mesh,
+                batch_per_device=cfg.batch_size // self.n_devices,
+                compute_dtype=compute_dtype,
             )
 
         self.start_epoch = 0
@@ -484,9 +501,20 @@ class Trainer:
                 last = self.train_epoch(epoch)
             history.log("train_epoch", epoch=epoch, **last)
             if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
-                t1, t5, vloss = validate(
-                    self.test_loader, self.state, self.eval_step, epoch=epoch
-                )
+                if self._fused_runner is not None:
+                    sums = {
+                        k: float(v)
+                        for k, v in self._fused_eval(self.state, *self._fused_test_data).items()
+                    }
+                    n = max(sums["count"], 1.0)
+                    t1 = sums["top1"] / n * 100.0
+                    t5 = sums["top5"] / n * 100.0
+                    vloss = sums["loss"] / n
+                    rank0_print(f" * Acc@1 {t1:.3f} Acc@5 {t5:.3f} (epoch {epoch}, fused)")
+                else:
+                    t1, t5, vloss = validate(
+                        self.test_loader, self.state, self.eval_step, epoch=epoch
+                    )
                 last.update(val_top1=t1, val_top5=t5, val_loss=vloss)
                 history.log("eval", epoch=epoch, top1=t1, top5=t5, loss=vloss)
                 if cfg.ckpt_dir and t1 > best_top1:
