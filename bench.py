@@ -228,6 +228,11 @@ def main() -> None:
         default=float(os.environ.get("BENCH_INIT_TIMEOUT", "600")),
     )
     p.add_argument(
+        "--table", action="store_true",
+        help="emit the reference README's comparison table (markdown), one "
+             "row per training mode, measured on the visible devices",
+    )
+    p.add_argument(
         "--scaling", action="store_true",
         help="run the config on 1,2,4,...,N-device meshes and report "
              "scaling efficiency (BASELINE's 1→8→32 chip metric; limited "
@@ -242,6 +247,24 @@ def main() -> None:
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
 
     _guarded_backend_init(args.init_timeout)
+    if args.table:
+        # reference README comparison-table parity (README.md:59-77): one
+        # row per training mode, same model/dataset, epoch seconds
+        rows = [
+            ("dataparallel (DP ≡ DDP on TPU)", "resnet18_cifar100_fp32"),
+            ("distributed + bf16 (apex path)", "resnet18_cifar100"),
+            ("grad accumulation ×4", "resnet18_cifar100_ga4"),
+            ("fused epoch (device-resident)", "resnet18_cifar100_fused"),
+        ]
+        print("| mode | sec/epoch | images/sec | vs 4x2080Ti DDP+apex |")
+        print("|---|---|---|---|")
+        for label, name in rows:
+            out = run(CONFIGS[name], args.steps, args.warmup)
+            print(
+                f"| {label} | {out['sec_per_epoch']} | {out['value']} "
+                f"| {out['vs_baseline']}x |"
+            )
+        return
     if args.scaling:
         n = len(jax.devices())
         sizes = [s for s in (1, 2, 4, 8, 16, 32) if s <= n]
