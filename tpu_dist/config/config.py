@@ -134,6 +134,8 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--keep_last_ckpts", type=int, default=None)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--log_file", type=str, default=None)
+    p.add_argument("--eval_every", type=int, default=d.eval_every)
+    p.add_argument("--save_every", type=int, default=d.save_every)
     p.add_argument("--steps_per_epoch", type=int, default=None)
     p.add_argument("--log_every", type=int, default=d.log_every)
     # accepted for command-line parity with torch.distributed.launch; unused
