@@ -82,6 +82,16 @@ def test_trainer_pp_e2e_with_eval_and_resume(tmp_path):
     assert np.isfinite(t2.fit()["loss"])
 
 
+def test_trainer_pp_microbatches_flag():
+    cfg = TrainConfig(
+        dataset="synthetic", model="vit_pp_tiny", num_classes=10, batch_size=16,
+        epochs=1, steps_per_epoch=1, log_every=1, lr=0.05, eval_every=0,
+        pp=4, pp_microbatches=8, sync_bn=False, synthetic_n=160,
+    )
+    out = Trainer(cfg).train_epoch(0)
+    assert np.isfinite(out["loss"])
+
+
 def test_trainer_pp_rejects_bad_configs():
     import pytest
 

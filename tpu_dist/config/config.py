@@ -65,6 +65,7 @@ class TrainConfig:
                                    # model must support ep_axis (ViT-MoE)
     pp: int = 1                    # pipeline-parallel stages (DPxPP mesh);
                                    # model must support pp_axis (ViT-PP)
+    pp_microbatches: int = 0       # 0 = one microbatch per stage
 
     # -- checkpoint / eval cadence -----------------------------------------
     ckpt_dir: Optional[str] = None
@@ -130,6 +131,7 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--tp", type=int, default=d.tp)
     p.add_argument("--ep", type=int, default=d.ep)
     p.add_argument("--pp", type=int, default=d.pp)
+    p.add_argument("--pp_microbatches", type=int, default=d.pp_microbatches)
     p.add_argument("--ckpt_dir", type=str, default=None)
     p.add_argument("--keep_last_ckpts", type=int, default=None)
     p.add_argument("--resume", action="store_true")

@@ -62,6 +62,7 @@ def make_train_step(
     pp_axis: str | None = None,
     param_specs=None,
     remat: bool = False,
+    model_kwargs: dict | None = None,
 ):
     """Build ``step(state, images, labels, lr) -> (state, metrics)``.
 
@@ -131,6 +132,8 @@ def make_train_step(
             kw["ep_axis"] = ep_axis
         if pp_axis is not None:
             kw["pp_axis"] = pp_axis
+        if model_kwargs:
+            kw.update(model_kwargs)
         logits, new_bn = model_apply(p, bn_state, x, train=True, axis_name=bn_axis, **kw)
         loss = F.cross_entropy(logits, labels, label_smoothing=label_smoothing)
         return loss, (new_bn, logits)

@@ -193,11 +193,12 @@ class Trainer:
                 raise ValueError(
                     "pp > 1 is incompatible with fused_epoch / zero1 / grad_clip_norm"
                 )
+            m = cfg.pp_microbatches or cfg.pp
             per_dev_batch = cfg.batch_size // max(1, self.n_data)
-            if per_dev_batch % cfg.pp:
+            if per_dev_batch % m:
                 raise ValueError(
                     f"per-data-shard batch {per_dev_batch} must divide into "
-                    f"{cfg.pp} microbatches"
+                    f"{m} microbatches"
                 )
             self._param_specs = self.model.pp_param_specs(mesh_lib.PIPE_AXIS)
 
@@ -302,6 +303,11 @@ class Trainer:
             pp_axis=mesh_lib.PIPE_AXIS if cfg.pp > 1 else None,
             param_specs=self._param_specs,
             remat=cfg.remat,
+            model_kwargs=(
+                {"n_microbatches": cfg.pp_microbatches}
+                if cfg.pp > 1 and cfg.pp_microbatches
+                else None
+            ),
         )
         self.eval_step = make_eval_step(
             self.model.apply, self.mesh, compute_dtype=compute_dtype, axis=eval_axes,
