@@ -53,6 +53,6 @@ class ProgressMeter:
 
     @staticmethod
     def _get_batch_fmtstr(num_batches: int) -> str:
-        num_digits = len(str(num_batches // 1))
+        num_digits = len(str(num_batches))
         fmt = "{:" + str(num_digits) + "d}"
         return "[" + fmt + "/" + fmt.format(num_batches) + "]"
