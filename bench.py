@@ -56,7 +56,7 @@ CONFIGS = {
         BenchConfig("resnet18_cifar100_ga4", "resnet18", 32, 100, 256, grad_accum=4),
         BenchConfig("resnet18_cifar100_fused", "resnet18", 32, 100, 256, fused_epoch=True),
         BenchConfig(
-            "resnet50_imagenet", "resnet50", 224, 1000, 64,
+            "resnet50_imagenet", "resnet50_imagenet", 224, 1000, 64,
             epoch_images=1_281_167,
         ),
         BenchConfig(
@@ -73,6 +73,7 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None)
 
     from tpu_dist.comm import mesh as mesh_lib
     from tpu_dist.nn import resnet18, resnet34, resnet50
+    from tpu_dist.nn.resnet import resnet50_imagenet
     from tpu_dist.nn.vit import vit_b16
     from tpu_dist.train.optim import SGD
     from tpu_dist.train.state import TrainState
@@ -80,6 +81,7 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None)
 
     models = {
         "resnet18": resnet18, "resnet34": resnet34, "resnet50": resnet50,
+        "resnet50_imagenet": resnet50_imagenet,
         "vit_b16": lambda num_classes: vit_b16(num_classes, cfg.image_size),
     }
     if n_devices is None:

@@ -29,6 +29,14 @@ def test_param_count_parity(factory, n_params, n_stats):
     assert sum(x.size for x in jax.tree_util.tree_leaves(state)) == n_stats
 
 
+def test_resnet50_imagenet_canonical_params():
+    from tpu_dist.nn.resnet import resnet50_imagenet
+
+    params, _ = resnet50_imagenet(num_classes=1000).init(jax.random.PRNGKey(0))
+    n = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    assert n == 25_557_032  # torchvision resnet50 exactly
+
+
 def test_forward_shapes_and_finiteness():
     m = tiny_resnet(num_classes=7)
     params, state = m.init(jax.random.PRNGKey(0))

@@ -36,6 +36,10 @@ class ResNetDef:
     stage_blocks: Tuple[int, int, int, int]
     num_classes: int = 100
     widths: Tuple[int, int, int, int] = (64, 128, 256, 512)
+    # CIFAR variant (reference default): 3x3 stem, no maxpool
+    # (utils/model.py:66-70). imagenet_stem=True switches to the canonical
+    # 7x7/stride-2 stem + 3x3/stride-2 maxpool for 224x224 inputs.
+    imagenet_stem: bool = False
 
     @property
     def expansion(self) -> int:
@@ -50,7 +54,8 @@ class ResNetDef:
         state = {}
 
         stem = self.widths[0]
-        params["stem_conv"] = L.conv_init(next(keys), 3, stem, 3, dtype)
+        stem_k = 7 if self.imagenet_stem else 3
+        params["stem_conv"] = L.conv_init(next(keys), 3, stem, stem_k, dtype)
         params["stem_bn"], state["stem_bn"] = L.bn_init(stem, dtype)
 
         in_ch = stem
@@ -112,9 +117,17 @@ class ResNetDef:
         bn = dict(train=train, axis_name=axis_name)
         new_state = {}
 
-        y = L.conv_apply(params["stem_conv"], x, stride=1, padding=1)
+        if self.imagenet_stem:
+            y = L.conv_apply(params["stem_conv"], x, stride=2, padding=3)
+        else:
+            y = L.conv_apply(params["stem_conv"], x, stride=1, padding=1)
         y, new_state["stem_bn"] = L.bn_apply(params["stem_bn"], state["stem_bn"], y, **bn)
         y = L.relu(y)
+        if self.imagenet_stem:
+            y = jax.lax.reduce_window(
+                y, -jnp.inf, jax.lax.max,
+                (1, 3, 3, 1), (1, 2, 2, 1), [(0, 0), (1, 1), (1, 1), (0, 0)],
+            )
 
         for si in range(4):
             name = f"stage{si + 1}"
@@ -168,3 +181,9 @@ def resnet34(num_classes: int = 100) -> ResNetDef:
 def resnet50(num_classes: int = 100) -> ResNetDef:
     """Reference factory parity: ``utils/model.py:125-127``."""
     return ResNetDef("bottleneck", (3, 4, 6, 3), num_classes)
+
+
+def resnet50_imagenet(num_classes: int = 1000) -> ResNetDef:
+    """Canonical ImageNet ResNet-50 (7x7 stem + maxpool; ~25.6M params) —
+    for the BASELINE ResNet-50/ImageNet-1k config."""
+    return ResNetDef("bottleneck", (3, 4, 6, 3), num_classes, imagenet_stem=True)

@@ -80,6 +80,10 @@ def build_model(cfg: TrainConfig):
         from tpu_dist.nn.vit_pp import vit_pp_tiny  # noqa: PLC0415
 
         _MODELS.setdefault("vit_pp_tiny", vit_pp_tiny)
+
+        from tpu_dist.nn.resnet import resnet50_imagenet  # noqa: PLC0415
+
+        _MODELS.setdefault("resnet50_imagenet", resnet50_imagenet)
     except ImportError:
         pass
     if cfg.model not in _MODELS:
