@@ -20,6 +20,15 @@ def test_vit_b16_param_count():
     assert 85e6 < n < 88e6, n
 
 
+def test_vit_s16_param_count():
+    from tpu_dist.nn.vit import vit_s16
+
+    p, _ = vit_s16().init(jax.random.PRNGKey(0))
+    n = sum(x.size for x in jax.tree_util.tree_leaves(p))
+    # ViT-S/16 published ≈ 22M (cls-token variant); mean-pool variant close
+    assert 20e6 < n < 23e6, n
+
+
 def test_vit_b16_accepts_smaller_images():
     # --model vit_b16 on CIFAR-sized input: uses the leading pos embeddings
     m = vit_b16(num_classes=10)
