@@ -24,5 +24,7 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-# persistent compile cache: repeat suite runs skip most XLA compiles
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache_tests")
+# NOTE: deliberately NO persistent compilation cache here — in this
+# environment cached XLA:CPU AOT artifacts can be loaded on a host with
+# different CPU features (containers migrate), which XLA warns may SIGILL.
+# Cold compiles cost ~2 extra minutes; flaky SIGILLs cost more.
