@@ -85,3 +85,39 @@ def test_indivisible_batch_rejected():
 def test_cifar_missing_data_is_loud(tmp_path):
     with pytest.raises(FileNotFoundError, match="CIFAR-100 not found"):
         load_cifar100(str(tmp_path))
+
+
+def test_cifar100_reads_pickle_layout(tmp_path):
+    import pickle
+
+    root = tmp_path / "cifar-100-python"
+    root.mkdir()
+    rng = np.random.default_rng(0)
+    raw = rng.integers(0, 256, (6, 3072), dtype=np.int64).astype(np.uint8)
+    with open(root / "train", "wb") as f:
+        pickle.dump({"data": raw, "fine_labels": list(range(6))}, f)
+    imgs, lbls = load_cifar100(str(tmp_path), train=True)
+    assert imgs.shape == (6, 32, 32, 3) and lbls.tolist() == [0, 1, 2, 3, 4, 5]
+    # channel-major 3072 -> NHWC round trip
+    np.testing.assert_array_equal(
+        imgs[0], raw[0].reshape(3, 32, 32).transpose(1, 2, 0)
+    )
+
+
+def test_cifar10_reads_batch_layout(tmp_path):
+    import pickle
+
+    from tpu_dist.data.cifar import load_cifar10
+
+    root = tmp_path / "cifar-10-batches-py"
+    root.mkdir()
+    rng = np.random.default_rng(1)
+    for i in range(1, 6):
+        raw = rng.integers(0, 256, (4, 3072), dtype=np.int64).astype(np.uint8)
+        with open(root / f"data_batch_{i}", "wb") as f:
+            pickle.dump({"data": raw, "labels": [i] * 4}, f)
+    imgs, lbls = load_cifar10(str(tmp_path), train=True)
+    assert imgs.shape == (20, 32, 32, 3)
+    assert lbls.tolist() == sum(([i] * 4 for i in range(1, 6)), [])
+    with pytest.raises(FileNotFoundError, match="CIFAR-10 not found"):
+        load_cifar10(str(tmp_path / "nope"))

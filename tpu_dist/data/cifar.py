@@ -47,3 +47,29 @@ def load_cifar100(data_dir: str = "./data", train: bool = True) -> Tuple[np.ndar
     data = np.asarray(d["data"], np.uint8).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
     labels = np.asarray(d["fine_labels"], np.int32)
     return np.ascontiguousarray(data), labels
+
+
+def load_cifar10(data_dir: str = "./data", train: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """CIFAR-10 in the standard ``cifar-10-batches-py`` layout
+    (``data_batch_1..5`` / ``test_batch`` pickles). Same NHWC uint8 output
+    contract as :func:`load_cifar100`."""
+    root = os.path.join(data_dir, "cifar-10-batches-py")
+    if not os.path.isdir(root):
+        tar = os.path.join(data_dir, "cifar-10-python.tar.gz")
+        if os.path.isfile(tar):
+            with tarfile.open(tar, "r:gz") as tf:
+                tf.extractall(data_dir)
+    if not os.path.isdir(root):
+        raise FileNotFoundError(
+            f"CIFAR-10 not found under {data_dir!r} (need cifar-10-batches-py/ "
+            "or cifar-10-python.tar.gz); no downloader in zero-egress envs."
+        )
+    names = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+    datas, labels = [], []
+    for n in names:
+        with open(os.path.join(root, n), "rb") as f:
+            d = pickle.load(f, encoding="latin1")
+        datas.append(np.asarray(d["data"], np.uint8))
+        labels.append(np.asarray(d["labels"], np.int32))
+    data = np.concatenate(datas).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return np.ascontiguousarray(data), np.concatenate(labels)

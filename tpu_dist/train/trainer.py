@@ -215,6 +215,11 @@ class Trainer:
         elif cfg.dataset == "cifar100":
             self.train_data = load_cifar100(cfg.data_dir, train=True)
             self.test_data = load_cifar100(cfg.data_dir, train=False)
+        elif cfg.dataset == "cifar10":
+            from tpu_dist.data.cifar import load_cifar10  # noqa: PLC0415
+
+            self.train_data = load_cifar10(cfg.data_dir, train=True)
+            self.test_data = load_cifar10(cfg.data_dir, train=False)
         else:
             raise ValueError(f"unknown dataset {cfg.dataset!r}")
 
