@@ -43,7 +43,7 @@ class TrainConfig:
     drop_last: bool = False        # grad-accum path uses True (…accumulation.py:71)
 
     # -- data ---------------------------------------------------------------
-    dataset: str = "cifar100"      # cifar100 | synthetic
+    dataset: str = "cifar100"      # cifar100 | cifar10 | synthetic
     data_dir: str = "./data"
     synthetic_n: int = 50_000      # synthetic train-set size (tests/smokes)
     num_workers: int = 4           # loader prefetch depth (passed to DataLoader)

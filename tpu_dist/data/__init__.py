@@ -1,5 +1,5 @@
 from tpu_dist.data.sampler import DistributedSampler  # noqa: F401
 from tpu_dist.data.loader import DataLoader  # noqa: F401
-from tpu_dist.data.cifar import load_cifar100  # noqa: F401
+from tpu_dist.data.cifar import load_cifar10, load_cifar100  # noqa: F401
 from tpu_dist.data.synthetic import synthetic_cifar  # noqa: F401
 from tpu_dist.data import transforms as transforms  # noqa: F401

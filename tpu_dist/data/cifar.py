@@ -17,21 +17,19 @@ from typing import Tuple
 
 import numpy as np
 
-_ARCHIVE = "cifar-100-python.tar.gz"
-_DIRNAME = "cifar-100-python"
-
-
-def _find_root(data_dir: str) -> str:
-    d = os.path.join(data_dir, _DIRNAME)
+def _find_root(data_dir: str, dirname: str, archive: str, label: str) -> str:
+    """Locate an extracted dataset dir, extracting the archive if present."""
+    d = os.path.join(data_dir, dirname)
     if os.path.isdir(d):
         return d
-    tar = os.path.join(data_dir, _ARCHIVE)
+    tar = os.path.join(data_dir, archive)
     if os.path.isfile(tar):
         with tarfile.open(tar, "r:gz") as tf:
             tf.extractall(data_dir)
-        return d
+        if os.path.isdir(d):
+            return d
     raise FileNotFoundError(
-        f"CIFAR-100 not found under {data_dir!r} (need {_DIRNAME}/ or {_ARCHIVE}); "
+        f"{label} not found under {data_dir!r} (need {dirname}/ or {archive}); "
         "this environment has no network egress — place the archive there, or use "
         "dataset='synthetic'."
     )
@@ -40,7 +38,7 @@ def _find_root(data_dir: str) -> str:
 def load_cifar100(data_dir: str = "./data", train: bool = True) -> Tuple[np.ndarray, np.ndarray]:
     """Returns ``(images_u8 [N,32,32,3], labels_i32 [N])`` — fine labels,
     matching the reference's ``datasets.CIFAR100`` splits."""
-    root = _find_root(data_dir)
+    root = _find_root(data_dir, "cifar-100-python", "cifar-100-python.tar.gz", "CIFAR-100")
     fname = "train" if train else "test"
     with open(os.path.join(root, fname), "rb") as f:
         d = pickle.load(f, encoding="latin1")
@@ -53,17 +51,7 @@ def load_cifar10(data_dir: str = "./data", train: bool = True) -> Tuple[np.ndarr
     """CIFAR-10 in the standard ``cifar-10-batches-py`` layout
     (``data_batch_1..5`` / ``test_batch`` pickles). Same NHWC uint8 output
     contract as :func:`load_cifar100`."""
-    root = os.path.join(data_dir, "cifar-10-batches-py")
-    if not os.path.isdir(root):
-        tar = os.path.join(data_dir, "cifar-10-python.tar.gz")
-        if os.path.isfile(tar):
-            with tarfile.open(tar, "r:gz") as tf:
-                tf.extractall(data_dir)
-    if not os.path.isdir(root):
-        raise FileNotFoundError(
-            f"CIFAR-10 not found under {data_dir!r} (need cifar-10-batches-py/ "
-            "or cifar-10-python.tar.gz); no downloader in zero-egress envs."
-        )
+    root = _find_root(data_dir, "cifar-10-batches-py", "cifar-10-python.tar.gz", "CIFAR-10")
     names = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
     datas, labels = [], []
     for n in names:

@@ -14,6 +14,9 @@ import numpy as np
 # utils/dataset.py:8,20
 CIFAR100_MEAN = np.array([0.5070751592371323, 0.48654887331495095, 0.4409178433670343], np.float32)
 CIFAR100_STD = np.array([0.2673342858792401, 0.2564384629170883, 0.27615047132568404], np.float32)
+# standard torchvision CIFAR-10 statistics
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
 
 
 def normalize(x: np.ndarray) -> np.ndarray:

@@ -38,6 +38,7 @@ from tpu_dist.config import TrainConfig
 from tpu_dist.data import (
     DataLoader,
     DistributedSampler,
+    load_cifar10,
     load_cifar100,
     synthetic_cifar,
 )
@@ -216,12 +217,17 @@ class Trainer:
             self.train_data = load_cifar100(cfg.data_dir, train=True)
             self.test_data = load_cifar100(cfg.data_dir, train=False)
         elif cfg.dataset == "cifar10":
-            from tpu_dist.data.cifar import load_cifar10  # noqa: PLC0415
-
             self.train_data = load_cifar10(cfg.data_dir, train=True)
             self.test_data = load_cifar10(cfg.data_dir, train=False)
         else:
             raise ValueError(f"unknown dataset {cfg.dataset!r}")
+        _DATASET_CLASSES = {"cifar100": 100, "cifar10": 10}
+        expected = _DATASET_CLASSES.get(cfg.dataset)
+        if expected is not None and cfg.num_classes != expected:
+            raise ValueError(
+                f"dataset {cfg.dataset!r} has {expected} classes but "
+                f"num_classes={cfg.num_classes}; pass --num_classes {expected}"
+            )
 
         nproc, pid = mesh_lib.process_count(), mesh_lib.process_index()
         # reference: per-worker batch = global / nprocs (distributed.py:67);
@@ -249,8 +255,15 @@ class Trainer:
         self.test_sampler = DistributedSampler(
             len(self.test_data[0]), nproc, pid, shuffle=False, seed=seed
         )
-        # fused C++ gather+crop+normalize when built; numpy otherwise
-        from tpu_dist.data import native  # noqa: PLC0415
+        # fused C++ gather+crop+normalize when built; numpy otherwise.
+        # Normalization statistics follow the dataset (CIFAR-100 stats are
+        # the reference's utils/dataset.py:8,20).
+        from tpu_dist.data import native, transforms  # noqa: PLC0415
+
+        if cfg.dataset == "cifar10":
+            stats = dict(mean=transforms.CIFAR10_MEAN, std=transforms.CIFAR10_STD)
+        else:
+            stats = dict(mean=transforms.CIFAR100_MEAN, std=transforms.CIFAR100_STD)
 
         # EP: the expert axis carries data everywhere outside the MoE, so the
         # TRAIN batch also shards over every device
@@ -268,13 +281,13 @@ class Trainer:
             eval_axes = mesh_lib.DATA_AXIS
         self.train_loader = DataLoader(
             *self.train_data, self.local_batch, self.train_sampler, self.mesh,
-            gather_transform=functools.partial(native.gather_augment, train=True),
+            gather_transform=functools.partial(native.gather_augment, train=True, **stats),
             seed=seed, prefetch=cfg.num_workers, batch_divisor=divisor,
             shard_axes=train_axes,
         )
         self.test_loader = DataLoader(
             *self.test_data, self.local_batch, self.test_sampler, self.mesh,
-            gather_transform=functools.partial(native.gather_augment, train=False),
+            gather_transform=functools.partial(native.gather_augment, train=False, **stats),
             seed=seed, with_mask=True, prefetch=cfg.num_workers,
             batch_divisor=eval_divisor, shard_axes=eval_axes,
         )
@@ -338,7 +351,7 @@ class Trainer:
             self._fused_runner = make_fused_epoch(
                 self.model.apply, self.optimizer, self.mesh,
                 batch_per_device=cfg.batch_size // self.n_devices,
-                sync_bn=cfg.sync_bn, compute_dtype=compute_dtype,
+                sync_bn=cfg.sync_bn, compute_dtype=compute_dtype, **stats,
             )
             # round the test set UP to a device multiple with label=-1
             # padding so fused eval counts every real example exactly once
@@ -351,7 +364,7 @@ class Trainer:
             self._fused_eval = make_fused_eval(
                 self.model.apply, self.mesh,
                 batch_per_device=cfg.batch_size // self.n_devices,
-                compute_dtype=compute_dtype,
+                compute_dtype=compute_dtype, **stats,
             )
 
         self.start_epoch = 0
