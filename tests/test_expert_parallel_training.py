@@ -100,6 +100,6 @@ def test_trainer_ep_rejects_bad_configs():
 
     with pytest.raises(ValueError, match="expert parallelism"):
         Trainer(TrainConfig(dataset="synthetic", model="resnet18", ep=4, synthetic_n=512))
-    with pytest.raises(ValueError, match="cannot be combined"):
+    with pytest.raises(ValueError, match="sp\\+tp"):
         Trainer(TrainConfig(dataset="synthetic", model="vit_moe_tiny", ep=2, tp=2,
                             synthetic_n=512))

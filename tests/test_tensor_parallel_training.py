@@ -110,8 +110,8 @@ def test_trainer_tp_rejects_bad_configs():
 
     with pytest.raises(ValueError, match="tensor parallelism"):
         Trainer(TrainConfig(dataset="synthetic", model="resnet18", tp=4, synthetic_n=512))
-    with pytest.raises(ValueError, match="cannot be combined"):
-        Trainer(TrainConfig(dataset="synthetic", model="vit_tiny", sp=2, tp=2, synthetic_n=512))
+    with pytest.raises(ValueError, match="sp\\+tp"):  # sp+ep is NOT a valid combo
+        Trainer(TrainConfig(dataset="synthetic", model="vit_tiny", sp=2, ep=2, synthetic_n=512))
     with pytest.raises(ValueError, match="incompatible"):
         Trainer(TrainConfig(
             dataset="synthetic", model="vit_tiny", tp=4, grad_clip_norm=1.0,

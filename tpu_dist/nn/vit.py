@@ -183,8 +183,6 @@ class ViTDef:
         SyncBN (there is no BN).
         """
         del axis_name
-        if tp_axis is not None and seq_axis is not None:
-            raise ValueError("tp_axis and seq_axis cannot be combined yet")
         if tokens is None:
             tokens = self.patchify(x)
             if seq_axis is not None:

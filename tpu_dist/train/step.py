@@ -95,11 +95,14 @@ def make_train_step(
     if tp_axis is not None:
         if param_specs is None:
             raise ValueError("tp_axis requires param_specs (per-leaf shardings)")
-        if shard_weight_update or grad_clip_norm > 0.0 or seq_axis is not None:
+        if shard_weight_update or grad_clip_norm > 0.0:
             raise ValueError(
                 "tp_axis is incompatible with shard_weight_update / "
-                "grad_clip_norm / seq_axis for now"
+                "grad_clip_norm for now"
             )
+        # tp_axis + seq_axis composes (3-D DPxTPxSP): the conjugate VJP ops
+        # absorb the model axis, grads pmean over data+seq — verified exact
+        # (tests/test_3d_mesh_training.py)
     if ep_axis is not None:
         if param_specs is None:
             raise ValueError("ep_axis requires param_specs (per-leaf shardings)")

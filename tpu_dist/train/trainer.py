@@ -100,10 +100,23 @@ class Trainer:
             num_processes=cfg.num_processes,
             process_id=cfg.process_id,
         )
-        if sum(w > 1 for w in (cfg.sp, cfg.tp, cfg.ep, cfg.pp)) > 1:
-            raise ValueError("sp, tp, ep and pp cannot be combined yet")
+        combined = sum(w > 1 for w in (cfg.sp, cfg.tp, cfg.ep, cfg.pp))
+        if combined > 1 and not (combined == 2 and cfg.sp > 1 and cfg.tp > 1):
+            raise ValueError(
+                "only sp+tp may be combined (3-D DPxTPxSP); other "
+                "sp/tp/ep/pp combinations are not supported yet"
+            )
         if mesh is not None:
             self.mesh = mesh
+        elif cfg.sp > 1 and cfg.tp > 1:
+            n = len(jax.devices())
+            ways = cfg.sp * cfg.tp
+            if n % ways:
+                raise ValueError(f"{n} devices not divisible by tp*sp={ways}")
+            self.mesh = mesh_lib.device_mesh(
+                [n // ways, cfg.tp, cfg.sp],
+                [mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS, mesh_lib.SEQ_AXIS],
+            )
         elif cfg.sp > 1 or cfg.tp > 1 or cfg.ep > 1 or cfg.pp > 1:
             ways = max(cfg.sp, cfg.tp, cfg.ep, cfg.pp)
             second = (
@@ -140,10 +153,11 @@ class Trainer:
                     f"model has {n_tokens} patch tokens, not divisible by "
                     f"sp={cfg.sp} — tokens would be dropped"
                 )
-            if cfg.batch_size % self.n_devices:
+            if cfg.batch_size % (self.n_data * cfg.sp):
                 raise ValueError(
                     f"with sp>1, batch_size {cfg.batch_size} must also divide "
-                    f"over all {self.n_devices} devices for evaluation sharding"
+                    f"over the {self.n_data * cfg.sp} data x seq devices for "
+                    f"evaluation sharding"
                 )
         self._param_specs = None
         if cfg.tp > 1:
@@ -271,14 +285,16 @@ class Trainer:
             (mesh_lib.DATA_AXIS, mesh_lib.EXPERT_AXIS) if cfg.ep > 1 else mesh_lib.DATA_AXIS
         )
         divisor = max(1, (self.n_devices if cfg.ep > 1 else self.n_data) // nproc)
-        # eval shards over EVERY device (incl. seq ways — no SP needed there)
-        eval_divisor = max(1, self.n_devices // nproc)
+        # eval shards over every non-model axis (seq/expert ways hold
+        # different examples — no SP/EP structure needed at eval time)
         if cfg.sp > 1:
             eval_axes = (mesh_lib.DATA_AXIS, mesh_lib.SEQ_AXIS)
         elif cfg.ep > 1:
             eval_axes = (mesh_lib.DATA_AXIS, mesh_lib.EXPERT_AXIS)
         else:
             eval_axes = mesh_lib.DATA_AXIS
+        eval_ways = self.n_data * (cfg.sp if cfg.sp > 1 else cfg.ep if cfg.ep > 1 else 1)
+        eval_divisor = max(1, eval_ways // nproc)
         self.train_loader = DataLoader(
             *self.train_data, self.local_batch, self.train_sampler, self.mesh,
             gather_transform=functools.partial(native.gather_augment, train=True, **stats),
