@@ -100,45 +100,70 @@ class TrainConfig:
 
 def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     d = TrainConfig()
-    p.add_argument("--batch_size", "--batch-size", type=int, default=d.batch_size)
+    p.add_argument("--batch_size", "--batch-size", type=int, default=d.batch_size,
+                   help="GLOBAL batch size (split across data-parallel devices)")
     p.add_argument("--epochs", type=int, default=d.epochs)
     p.add_argument("--lr", type=float, default=d.lr)
-    p.add_argument("--seed", type=int, default=None)
-    p.add_argument("--ip", type=str, default=d.ip)
+    p.add_argument("--seed", type=int, default=None,
+                   help="deterministic seeding (reference init_seeds semantics)")
+    p.add_argument("--ip", type=str, default=d.ip,
+                   help="multi-host coordinator address (reference --ip)")
     p.add_argument("--port", type=int, default=d.port)
-    p.add_argument("--grad_accu_steps", type=int, default=d.grad_accu_steps)
+    p.add_argument("--grad_accu_steps", type=int, default=d.grad_accu_steps,
+                   help="gradient accumulation sub-steps (no_sync semantics)")
     p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--weight_decay", type=float, default=d.weight_decay)
     p.add_argument("--lr_schedule", choices=("multistep", "cosine"), default=d.lr_schedule)
-    p.add_argument("--warmup_epochs", type=int, default=d.warmup_epochs)
+    p.add_argument("--warmup_epochs", type=int, default=d.warmup_epochs,
+                   help="linear warmup epochs (cosine schedule only)")
     p.add_argument("--label_smoothing", type=float, default=d.label_smoothing)
-    p.add_argument("--grad_clip_norm", type=float, default=d.grad_clip_norm)
-    p.add_argument("--bf16", action="store_true")
-    p.add_argument("--fused_epoch", action="store_true")
-    p.add_argument("--shard_weight_update", "--zero1", action="store_true")
-    p.add_argument("--fused_optimizer", action="store_true")
-    p.add_argument("--remat", action="store_true")
-    p.add_argument("--no_sync_bn", dest="sync_bn", action="store_false")
+    p.add_argument("--grad_clip_norm", type=float, default=d.grad_clip_norm,
+                   help="global-norm gradient clip; 0 disables")
+    p.add_argument("--bf16", action="store_true",
+                   help="bf16 compute policy (the apex-AMP equivalent)")
+    p.add_argument("--fused_epoch", action="store_true",
+                   help="device-resident data: one jit call per epoch")
+    p.add_argument("--shard_weight_update", "--zero1", action="store_true",
+                   help="ZeRO-1 weight-update sharding (arXiv:2004.13336)")
+    p.add_argument("--fused_optimizer", action="store_true",
+                   help="Pallas fused SGD kernel")
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint the forward (less activation memory)")
+    p.add_argument("--no_sync_bn", dest="sync_bn", action="store_false",
+                   help="per-replica BatchNorm statistics (SyncBN off)")
     p.add_argument("--no_nan_guard", dest="nan_guard", action="store_false")
-    p.add_argument("--dataset", type=str, default=d.dataset)
+    p.add_argument("--dataset", type=str, default=d.dataset,
+                   help="cifar100 | cifar10 | synthetic")
     p.add_argument("--data_dir", type=str, default=d.data_dir)
-    p.add_argument("--synthetic_n", type=int, default=d.synthetic_n)
-    p.add_argument("--model", type=str, default=d.model)
+    p.add_argument("--synthetic_n", type=int, default=d.synthetic_n,
+                   help="synthetic train-set size")
+    p.add_argument("--model", type=str, default=d.model,
+                   help="resnet18/34/50, resnet50_imagenet, vit_b16/s16/tiny, "
+                        "vit_moe_tiny, vit_pp_tiny, or a register_model name")
     p.add_argument("--num_classes", type=int, default=d.num_classes)
-    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--num_processes", type=int, default=None,
+                   help="multi-host world size (one process per host)")
     p.add_argument("--process_id", type=int, default=None)
-    p.add_argument("--sp", type=int, default=d.sp)
-    p.add_argument("--tp", type=int, default=d.tp)
-    p.add_argument("--ep", type=int, default=d.ep)
-    p.add_argument("--pp", type=int, default=d.pp)
-    p.add_argument("--pp_microbatches", type=int, default=d.pp_microbatches)
+    p.add_argument("--sp", type=int, default=d.sp,
+                   help="sequence-parallel ways (ring attention; ViT)")
+    p.add_argument("--tp", type=int, default=d.tp,
+                   help="tensor-parallel ways (Megatron; ViT); composes with --sp")
+    p.add_argument("--ep", type=int, default=d.ep,
+                   help="expert-parallel ways (MoE ViT)")
+    p.add_argument("--pp", type=int, default=d.pp,
+                   help="pipeline stages (staged ViT)")
+    p.add_argument("--pp_microbatches", type=int, default=d.pp_microbatches,
+                   help="pipeline microbatches; 0 = one per stage")
     p.add_argument("--ckpt_dir", type=str, default=None)
     p.add_argument("--keep_last_ckpts", type=int, default=None)
     p.add_argument("--resume", action="store_true")
-    p.add_argument("--log_file", type=str, default=None)
-    p.add_argument("--eval_every", type=int, default=d.eval_every)
+    p.add_argument("--log_file", type=str, default=None,
+                   help="JSONL metrics history path (rank 0)")
+    p.add_argument("--eval_every", type=int, default=d.eval_every,
+                   help="epochs between evaluations; 0 disables")
     p.add_argument("--save_every", type=int, default=d.save_every)
-    p.add_argument("--steps_per_epoch", type=int, default=None)
+    p.add_argument("--steps_per_epoch", type=int, default=None,
+                   help="cap steps per epoch (smokes/benches)")
     p.add_argument("--log_every", type=int, default=d.log_every)
     # accepted for command-line parity with torch.distributed.launch; unused
     p.add_argument("--local_rank", type=int, default=0, help=argparse.SUPPRESS)
