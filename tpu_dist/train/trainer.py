@@ -227,6 +227,13 @@ class Trainer:
             self.test_data = synthetic_cifar(
                 max(cfg.synthetic_n // 5, self.n_devices), cfg.num_classes, seed=2
             )
+        elif cfg.dataset == "synthetic_learnable":
+            from tpu_dist.data.synthetic import synthetic_quadrant  # noqa: PLC0415
+
+            self.train_data = synthetic_quadrant(cfg.synthetic_n, seed=1)
+            self.test_data = synthetic_quadrant(
+                max(cfg.synthetic_n // 5, self.n_devices), seed=2
+            )
         elif cfg.dataset == "cifar100":
             self.train_data = load_cifar100(cfg.data_dir, train=True)
             self.test_data = load_cifar100(cfg.data_dir, train=False)
@@ -235,7 +242,7 @@ class Trainer:
             self.test_data = load_cifar10(cfg.data_dir, train=False)
         else:
             raise ValueError(f"unknown dataset {cfg.dataset!r}")
-        _DATASET_CLASSES = {"cifar100": 100, "cifar10": 10}
+        _DATASET_CLASSES = {"cifar100": 100, "cifar10": 10, "synthetic_learnable": 4}
         expected = _DATASET_CLASSES.get(cfg.dataset)
         if expected is not None and cfg.num_classes != expected:
             raise ValueError(
