@@ -1,0 +1,36 @@
+"""Bench harness config integrity (no heavy compute — registry drift guard)."""
+
+import json
+import subprocess
+import sys
+
+import bench
+
+
+def test_all_configs_have_resolvable_models():
+    from tpu_dist.nn import resnet18, resnet34, resnet50
+    from tpu_dist.nn.resnet import resnet50_imagenet
+    from tpu_dist.nn.vit import vit_b16
+
+    known = {"resnet18", "resnet34", "resnet50", "resnet50_imagenet", "vit_b16"}
+    for name, cfg in bench.CONFIGS.items():
+        assert cfg.model in known, (name, cfg.model)
+        assert cfg.global_batch % cfg.grad_accum == 0
+        assert cfg.epoch_images > 0
+
+
+def test_config_names_match_keys():
+    for name, cfg in bench.CONFIGS.items():
+        assert cfg.name == name
+
+
+def test_bench_help_runs():
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--help"],
+        capture_output=True, text=True, timeout=120,
+        env={"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+             "PATH": "/usr/bin:/bin:/usr/local/bin", "PYTHONPATH": "."},
+        cwd=".",
+    )
+    assert out.returncode == 0
+    assert "--scaling" in out.stdout and "--all" in out.stdout
