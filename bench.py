@@ -33,6 +33,58 @@ import numpy as np
 BASELINE_IMG_PER_SEC = 50_000 / 14.5  # DDP+apex, 4x2080Ti (README.md:77)
 CIFAR_TRAIN = 50_000
 
+# Peak dense matmul FLOP/s per chip (bf16), used for the MFU denominator.
+# Public spec-sheet numbers; unknown kinds (incl. CPU emulation) yield
+# mfu=None rather than a made-up figure.
+CHIP_PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _chip_peak_flops() -> float | None:
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for name, peak in sorted(CHIP_PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+        if kind.startswith(name):
+            return peak
+    return None
+
+
+def _step_flops(compiled, loop_trips: int = 1) -> float | None:
+    """Total FLOPs of one compiled step from XLA's cost analysis (counts the
+    real fwd+bwd+update HLO, not an analytic guess).
+
+    ``loop_trips``: XLA cost analysis counts a while/scan body ONCE, so for
+    steps built around an inner loop (grad accumulation scan, fused-epoch
+    step scan) the caller passes the trip count; the body dominates the
+    program, so multiplying the whole count errs by at most the loop-external
+    ops (a few %, overestimating trips-1 copies of them)."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = ca.get("flops")
+        return float(flops) * loop_trips if flops and flops > 0 else None
+    except Exception:
+        return None
+
+
+def _mfu(flops_per_step: float | None, step_seconds: float, n_devices: int) -> float | None:
+    """Model FLOPs utilization: achieved FLOP/s over aggregate chip peak."""
+    peak = _chip_peak_flops()
+    if flops_per_step is None or peak is None or step_seconds <= 0:
+        return None
+    return round(flops_per_step / step_seconds / (peak * n_devices), 4)
+
 
 @dataclass(frozen=True)
 class BenchConfig:
@@ -120,13 +172,23 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None)
         mesh, rng.integers(0, cfg.num_classes, batch).astype(np.int32)
     )
 
+    # AOT-compile once: the same executable serves cost analysis (MFU
+    # numerator) AND the measured loop — no double compile.
+    try:
+        compiled = step.lower(state, images, labels, 0.1).compile()
+        flops_per_step = _step_flops(compiled, loop_trips=cfg.grad_accum)
+        call = compiled
+    except Exception:
+        flops_per_step = None
+        call = step
+
     for _ in range(warmup):
-        state, metrics = step(state, images, labels, 0.1)
+        state, metrics = call(state, images, labels, 0.1)
     jax.block_until_ready(state.params)
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        state, metrics = step(state, images, labels, 0.1)
+        state, metrics = call(state, images, labels, 0.1)
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
 
@@ -141,6 +203,7 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None)
         "global_batch": batch,
         "img_per_sec_per_chip": round(img_per_sec / n_dev, 1),
         "step_ms": round(1000 * dt / steps, 2),
+        "mfu": _mfu(flops_per_step, dt / steps, n_dev),
     }
 
 
@@ -165,14 +228,24 @@ def _run_fused(cfg: BenchConfig, mesh, model, optimizer, state, n_dev: int, batc
         sync_bn=cfg.sync_bn,
         compute_dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
     )
-    # warmup epoch (compile)
-    state, m = runner(state, dx, dy, 0.1, 0)
+    # AOT-compile once (cost analysis + the measured loop share it)
+    try:
+        compiled = runner.lower(state, dx, dy, 0.1, 0).compile()
+        steps_per_epoch = max(1, int(dx.shape[0]) // batch)
+        flops_per_epoch = _step_flops(compiled, loop_trips=steps_per_epoch)
+        call = compiled
+    except Exception:
+        flops_per_epoch = None
+        call = runner
+
+    # warmup epoch
+    state, m = call(state, dx, dy, 0.1, 0)
     jax.block_until_ready(state.params)
 
     n_epochs = 3
     t0 = _t.perf_counter()
     for e in range(1, n_epochs + 1):
-        state, m = runner(state, dx, dy, 0.1, e)
+        state, m = call(state, dx, dy, 0.1, e)
     jax.block_until_ready(state.params)
     dt = (_t.perf_counter() - t0) / n_epochs
 
@@ -187,6 +260,7 @@ def _run_fused(cfg: BenchConfig, mesh, model, optimizer, state, n_dev: int, batc
         "n_devices": n_dev,
         "global_batch": batch,
         "img_per_sec_per_chip": round(img_per_sec / n_dev, 1),
+        "mfu": _mfu(flops_per_epoch, dt, n_dev),
     }
 
 

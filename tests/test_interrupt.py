@@ -31,9 +31,92 @@ def test_interrupt_saves_emergency_checkpoint(tmp_path, monkeypatch):
     monkeypatch.setattr(t, "train_epoch", interrupting)
     with pytest.raises(KeyboardInterrupt):
         t.fit()
+    # interrupted mid-epoch 1 -> snapshot is filed under epoch 0, so resume
+    # re-runs the incomplete epoch 1 instead of skipping its remainder
     found = latest_checkpoint(str(tmp_path))
     assert found is not None  # emergency snapshot written
-    # resume picks it up
+    assert found[1] == 0
     t2 = Trainer(cfg.replace(resume=True))
-    assert t2.start_epoch >= 1
+    assert t2.start_epoch == 1
     assert np.isfinite(float(t2.state.params["fc"]["b"][0]))
+
+
+def test_interrupt_in_first_epoch_saves_nothing(tmp_path, monkeypatch):
+    """An interrupt inside epoch 0 writes no snapshot: a fresh start re-runs
+    epoch 0 anyway, and a partial-epoch ckpt would masquerade as complete."""
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet_i", num_classes=10,
+        batch_size=64, epochs=5, steps_per_epoch=1, log_every=10,
+        eval_every=0, ckpt_dir=str(tmp_path), save_every=100,
+        synthetic_n=640,
+    )
+    t = Trainer(cfg)
+
+    def interrupting(epoch):
+        raise KeyboardInterrupt
+
+    monkeypatch.setattr(t, "train_epoch", interrupting)
+    with pytest.raises(KeyboardInterrupt):
+        t.fit()
+    assert latest_checkpoint(str(tmp_path)) is None
+
+
+def test_interrupt_between_epochs_saves_completed_epoch(tmp_path, monkeypatch):
+    """Ctrl-C in the eval/save window after train_epoch(N) returned saves the
+    COMPLETE epoch-N state under N (not N-1 — that would re-train a finished
+    epoch)."""
+    import tpu_dist.train.trainer as trainer_mod
+
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet_i", num_classes=10,
+        batch_size=64, epochs=5, steps_per_epoch=1, log_every=10,
+        eval_every=1, ckpt_dir=str(tmp_path), save_every=100,
+        synthetic_n=640,
+    )
+    t = Trainer(cfg)
+
+    def interrupting_validate(*a, **kw):
+        raise KeyboardInterrupt
+
+    monkeypatch.setattr(trainer_mod, "validate", interrupting_validate)
+    with pytest.raises(KeyboardInterrupt):
+        t.fit()
+    found = latest_checkpoint(str(tmp_path))
+    assert found is not None and found[1] == 0  # epoch 0 completed -> saved as 0
+    t2 = Trainer(cfg.replace(resume=True))
+    assert t2.start_epoch == 1  # epoch 0 not re-run
+
+
+def test_interrupt_mid_epoch_keeps_clean_boundary_ckpt(tmp_path, monkeypatch):
+    """A mid-epoch interrupt must not overwrite an existing clean
+    end-of-epoch checkpoint with mid-epoch state."""
+    import os
+
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet_i", num_classes=10,
+        batch_size=64, epochs=5, steps_per_epoch=1, log_every=10,
+        eval_every=0, ckpt_dir=str(tmp_path), save_every=1,  # ckpt each epoch
+        synthetic_n=640,
+    )
+    t = Trainer(cfg)
+    calls = {"n": 0}
+    orig = t.train_epoch
+    ckpt0 = os.path.join(str(tmp_path), "ckpt_0.npz")
+    clean_mtime = {}
+
+    def interrupting(epoch):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            # clean ckpt_0 exists now (save_every=1); record its mtime
+            # BEFORE the emergency path gets a chance to rewrite it
+            clean_mtime["t"] = os.path.getmtime(ckpt0)
+            raise KeyboardInterrupt
+        return orig(epoch)
+
+    monkeypatch.setattr(t, "train_epoch", interrupting)
+    with pytest.raises(KeyboardInterrupt):
+        t.fit()
+    # the mid-epoch-1 interrupt must keep the clean boundary ckpt untouched
+    found = latest_checkpoint(str(tmp_path))
+    assert found is not None and found[1] == 0
+    assert os.path.getmtime(ckpt0) == clean_mtime["t"]

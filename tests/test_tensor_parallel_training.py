@@ -113,7 +113,8 @@ def test_trainer_tp_rejects_bad_configs():
     with pytest.raises(ValueError, match="sp\\+tp"):  # sp+ep is NOT a valid combo
         Trainer(TrainConfig(dataset="synthetic", model="vit_tiny", sp=2, ep=2, synthetic_n=512))
     with pytest.raises(ValueError, match="incompatible"):
+        # grad_clip_norm now composes with tp; ZeRO-1 remains structural
         Trainer(TrainConfig(
-            dataset="synthetic", model="vit_tiny", tp=4, grad_clip_norm=1.0,
+            dataset="synthetic", model="vit_tiny", tp=4, shard_weight_update=True,
             synthetic_n=512, batch_size=16,
         ))

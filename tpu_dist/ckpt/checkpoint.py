@@ -26,11 +26,23 @@ from tpu_dist.train.state import TrainState
 _CKPT_RE = re.compile(r"ckpt_(\d+)\.npz$")
 
 
+def _leaf_to_host(leaf) -> np.ndarray:
+    """Bring one leaf fully to host. Leaves sharded across processes (ZeRO-1
+    opt state under P('data'), TP-sharded params on a multi-host mesh) are
+    not addressable from process 0 alone — gather them collectively first.
+    NOTE: collective ⇒ every process must reach this call (see save())."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils  # noqa: PLC0415
+
+        return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+    return np.asarray(jax.device_get(leaf))
+
+
 def _flatten(tree) -> dict:
     flat = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
         key = jax.tree_util.keystr(path)
-        flat[key] = np.asarray(jax.device_get(leaf))
+        flat[key] = _leaf_to_host(leaf)
     return flat
 
 
@@ -54,10 +66,12 @@ def save(
     """Write ``ckpt_{epoch}.npz``; no-op off process 0 (rank-0 guard).
 
     ``keep_last``: prune to the N newest checkpoints after writing."""
+    # flatten BEFORE the rank-0 guard: gathering cross-process-sharded
+    # leaves is collective, so every process must participate
+    flat = _flatten(state._asdict())
     if jax.process_index() != 0:
         return None
     os.makedirs(ckpt_dir, exist_ok=True)
-    flat = _flatten(state._asdict())
     flat["__meta__"] = np.frombuffer(
         json.dumps({"epoch": epoch, "step": int(jax.device_get(state.step))}).encode(),
         dtype=np.uint8,
@@ -83,10 +97,10 @@ def save(
 
 def save_best(ckpt_dir: str, state: TrainState, epoch: int, metric: float) -> Optional[str]:
     """Write/overwrite ``ckpt_best.npz`` (rank-0, atomic) tagging the metric."""
+    flat = _flatten(state._asdict())  # collective: before the rank-0 guard
     if jax.process_index() != 0:
         return None
     os.makedirs(ckpt_dir, exist_ok=True)
-    flat = _flatten(state._asdict())
     flat["__meta__"] = np.frombuffer(
         json.dumps({"epoch": epoch, "metric": metric}).encode(), dtype=np.uint8
     )

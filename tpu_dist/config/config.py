@@ -89,6 +89,13 @@ class TrainConfig:
     debug_replica_check: bool = False  # assert params replicated each epoch
     profile_dir: Optional[str] = None  # capture an XLA trace of epoch 0
     nan_guard: bool = True         # raise TrainingDivergedError on NaN loss
+    compile_cache_dir: Optional[str] = None  # persistent XLA compile cache:
+                                   # repeat invocations of the same config
+                                   # skip the cold first-compile. NOTE:
+                                   # applied as PROCESS-GLOBAL jax.config
+                                   # state (XLA's cache is per-process) —
+                                   # it persists for later Trainers in the
+                                   # same process
 
     @property
     def coordinator_address(self) -> str:
@@ -165,6 +172,9 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--steps_per_epoch", type=int, default=None,
                    help="cap steps per epoch (smokes/benches)")
     p.add_argument("--log_every", type=int, default=d.log_every)
+    p.add_argument("--compile_cache_dir", type=str, default=None,
+                   help="persistent XLA compile-cache dir (repeat runs skip "
+                        "the cold first compile)")
     # accepted for command-line parity with torch.distributed.launch; unused
     p.add_argument("--local_rank", type=int, default=0, help=argparse.SUPPRESS)
     p.add_argument("--gpu", type=str, default=None, help=argparse.SUPPRESS)

@@ -65,7 +65,9 @@ def _load() -> Optional[ctypes.CDLL]:
             if lib.tpu_dist_pipeline_abi_version() != 1:
                 return None
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale/foreign .so missing our symbols — the
+            # promised silent numpy fallback must cover that case too.
             return None
         return _lib
 

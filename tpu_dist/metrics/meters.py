@@ -1,58 +1,82 @@
 """Running-average meters and progress display.
 
-API-parity with the reference's metrics kit (``utils/util.py:11-48``):
-``AverageMeter(name, fmt)`` keeps val/avg/sum/count with the same ``__str__``
-format; ``ProgressMeter(num_batches, meters, prefix)`` prints the same
-``[ 12/196] loss 1.23 (1.50)`` lines. The cross-replica part of the
-reference kit (``reduce_mean``, ``utils/util.py:5-9``) lives in
-``tpu_dist.comm.collectives`` and — in the hot path — inside the compiled
-step, so meters here only ever see already-reduced host scalars.
+Fills the role of the reference's metrics kit (``utils/util.py:11-48``) and
+keeps its *display contract* — ``loss 1.23 (1.50)`` per meter and
+``[ 12/196]`` step counters — but is this repo's own implementation: a
+running-sum core behind read-only properties, rendering via :func:`format`
+with a plain format-spec, and a progress line built from string padding
+rather than assembled format templates.
+
+The cross-replica part of the reference kit (``reduce_mean``,
+``utils/util.py:5-9``) lives in ``tpu_dist.comm.collectives`` and — in the
+hot path — inside the compiled step, so meters here only ever see
+already-reduced host scalars.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field
 
+
+@dataclass
 class AverageMeter:
-    """Computes and stores the average and current value."""
+    """Tracks the latest value and the n-weighted running mean of a scalar.
 
-    def __init__(self, name: str, fmt: str = ":f"):
-        self.name = name
-        self.fmt = fmt
-        self.reset()
+    ``fmt`` is a format spec (with or without the leading ``:``) applied to
+    both the latest and the mean value in ``str(meter)``.
+    """
+
+    name: str
+    fmt: str = ":f"
+    _total: float = field(default=0.0, repr=False)
+    _weight: int = field(default=0, repr=False)
+    _latest: float = field(default=0.0, repr=False)
+
+    @property
+    def val(self) -> float:
+        return self._latest
+
+    @property
+    def sum(self) -> float:
+        return self._total
+
+    @property
+    def count(self) -> int:
+        return self._weight
+
+    @property
+    def avg(self) -> float:
+        return self._total / self._weight if self._weight else 0.0
 
     def reset(self) -> None:
-        self.val = 0.0
-        self.avg = 0.0
-        self.sum = 0.0
-        self.count = 0
+        self._total, self._weight, self._latest = 0.0, 0, 0.0
 
     def update(self, val: float, n: int = 1) -> None:
-        val = float(val)
-        self.val = val
-        self.sum += val * n
-        self.count += n
-        self.avg = self.sum / max(self.count, 1)
+        self._latest = float(val)
+        self._total += self._latest * n
+        self._weight += n
 
     def __str__(self) -> str:
-        fmtstr = "{name} {val" + self.fmt + "} ({avg" + self.fmt + "})"
-        return fmtstr.format(**self.__dict__)
+        spec = self.fmt.lstrip(":")
+        return f"{self.name} {format(self.val, spec)} ({format(self.avg, spec)})"
 
 
 class ProgressMeter:
+    """Prints a tab-joined progress line: a ``[ cur/total]`` step counter
+    (current padded to total's width) followed by each meter's ``str``."""
+
     def __init__(self, num_batches: int, *meters: AverageMeter, prefix: str = ""):
-        self.batch_fmtstr = self._get_batch_fmtstr(num_batches)
-        self.meters = meters
+        self.num_batches = num_batches
+        self.meters = list(meters)
         self.prefix = prefix
 
+    def _counter(self, batch: int) -> str:
+        total = str(self.num_batches)
+        return f"[{str(batch).rjust(len(total))}/{total}]"
+
     def display(self, batch: int) -> str:
-        entries = [self.prefix + self.batch_fmtstr.format(batch)]
-        entries += [str(m) for m in self.meters]
-        line = "\t".join(entries)
+        line = "\t".join(
+            [self.prefix + self._counter(batch), *map(str, self.meters)]
+        )
         print(line, flush=True)
         return line
-
-    @staticmethod
-    def _get_batch_fmtstr(num_batches: int) -> str:
-        num_digits = len(str(num_batches))
-        fmt = "{:" + str(num_digits) + "d}"
-        return "[" + fmt + "/" + fmt.format(num_batches) + "]"

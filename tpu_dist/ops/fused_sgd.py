@@ -53,6 +53,12 @@ def fused_sgd_leaf(p, g, b, lr, *, momentum: float = 0.9, weight_decay: float = 
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if not pallas_supported():
+        raise RuntimeError(
+            "fused SGD requires jax.experimental.pallas.tpu, which failed to "
+            "import in this environment — use SGD(fused=False) (the plain jnp "
+            "update; bit-comparable, see tests/test_fused_sgd.py)"
+        )
 
     orig_shape, orig_dtype = p.shape, p.dtype
     n = p.size

@@ -92,31 +92,47 @@ def make_train_step(
     """
     K = int(grad_accum_steps)
     n_axis = int(mesh.shape[axis])
+    # Composition walls. grad_clip_norm composes with EVERY axis (the clip
+    # computes a shard-aware global norm — see clip_grads). The remaining
+    # exclusions are genuinely structural, not deferred work:
     if tp_axis is not None:
         if param_specs is None:
             raise ValueError("tp_axis requires param_specs (per-leaf shardings)")
-        if shard_weight_update or grad_clip_norm > 0.0:
-            raise ValueError(
-                "tp_axis is incompatible with shard_weight_update / "
-                "grad_clip_norm for now"
-            )
+        if shard_weight_update:
+            # ZeRO-1 ravels the LOCAL param tree into one flat vector and
+            # reduce-scatters it over the data axis; under TP the local tree
+            # is a per-shard slice, so the flat layout (and the sharded
+            # momentum buffer from init_sharded_opt_state, sized from GLOBAL
+            # params) differs per model shard. Composing them needs a
+            # per-tp-shard flat layout — tracked, not yet built.
+            raise ValueError("tp_axis + shard_weight_update is not supported yet")
         # tp_axis + seq_axis composes (3-D DPxTPxSP): the conjugate VJP ops
         # absorb the model axis, grads pmean over data+seq — verified exact
         # (tests/test_3d_mesh_training.py)
     if ep_axis is not None:
         if param_specs is None:
             raise ValueError("ep_axis requires param_specs (per-leaf shardings)")
-        if shard_weight_update or grad_clip_norm > 0.0 or seq_axis or tp_axis:
+        if shard_weight_update or seq_axis or tp_axis:
+            # ZeRO-1: same flat-layout conflict as under TP. seq/tp: the MoE
+            # model's dispatch all_to_all and the ring-attention / Megatron
+            # sharding would have to thread the same token dimension through
+            # two conflicting layouts — a model-architecture change, not a
+            # step-function flag.
             raise ValueError(
                 "ep_axis is incompatible with shard_weight_update / "
-                "grad_clip_norm / seq_axis / tp_axis for now"
+                "seq_axis / tp_axis (structural; see docstring)"
             )
     if pp_axis is not None:
         if param_specs is None:
             raise ValueError("pp_axis requires param_specs (per-leaf shardings)")
-        if shard_weight_update or grad_clip_norm > 0.0 or seq_axis or tp_axis or ep_axis:
+        if shard_weight_update or seq_axis or tp_axis or ep_axis:
+            # ZeRO-1: flat-layout conflict (stage-sharded leaves). seq/tp/ep
+            # inside a pipeline stage require a 3-D+ mesh with per-stage
+            # sub-meshes — the stage ring (ppermute over pipe) would need
+            # every other collective nested under it.
             raise ValueError(
-                "pp_axis is incompatible with other parallel modes for now"
+                "pp_axis is incompatible with shard_weight_update / "
+                "seq_axis / tp_axis / ep_axis (structural; see docstring)"
             )
     # the expert axis doubles as a data axis outside the MoE: batch shards
     # over both, metrics/loss reduce over both
@@ -143,10 +159,46 @@ def make_train_step(
 
     def clip_grads(grads):
         """Global-norm clip on the ALREADY-REDUCED grads (so the norm is the
-        true global-batch gradient norm, identical on every replica)."""
+        true global-batch gradient norm, identical on every replica).
+
+        Under model parallelism (tp/ep/pp) some leaves are SHARDED across a
+        model axis — their local sum-of-squares is only this shard's slice of
+        the leaf's norm. Leaves are grouped by the model axes in their spec:
+        each sharded group's sum gets one ``psum`` over those axes
+        (shard-norm pattern, same as the ZeRO-1 path below); replicated
+        leaves' grads are identical on every model shard (the model's VJP
+        collectives guarantee it) and contribute locally. A final ``pmean``
+        keeps the scale bit-identical on every shard."""
         if grad_clip_norm <= 0.0:
             return grads
-        sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree_util.tree_leaves(grads))
+        model_axes = tuple(a for a in (tp_axis, ep_axis, pp_axis) if a is not None)
+        if not model_axes or param_specs is None:
+            sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree_util.tree_leaves(grads))
+        else:
+            def leaf_model_axes(spec):
+                names = set()
+                for entry in spec:
+                    for name in (entry if isinstance(entry, tuple) else (entry,)):
+                        if name is not None:
+                            names.add(name)
+                return tuple(a for a in model_axes if a in names)
+
+            groups: dict = {}
+
+            def accumulate(g, spec):
+                groups.setdefault(leaf_model_axes(spec), []).append(
+                    jnp.sum(jnp.square(g))
+                )
+                return g
+
+            jax.tree_util.tree_map(accumulate, grads, param_specs)
+            sq = 0.0
+            for axes, sums in groups.items():
+                group_sq = sum(sums)
+                if axes:
+                    group_sq = lax.psum(group_sq, axes)
+                sq = sq + group_sq
+            sq = lax.pmean(sq, model_axes)
         norm = jnp.sqrt(sq)
         scale = jnp.minimum(1.0, grad_clip_norm / jnp.maximum(norm, 1e-12))
         return jax.tree_util.tree_map(lambda g: g * scale, grads)
