@@ -1,0 +1,101 @@
+"""Worker for the multi-host × tensor-parallel test (VERDICT r1 #6).
+
+Launched by tests/test_multihost.py as 2 processes × 4 CPU devices: one
+8-device global mesh laid out ``[data=4, model=2]`` HOST-MAJOR, so every
+tp=2 group is intra-host (the ICI side of the ICI/DCN split). The same
+``run_tp_training`` is also called by the parent test in-process
+(1 process × 8 devices) as the reference — replicated leaves, TP-sharded
+leaves and the loss must come out identical across both layouts and across
+both workers.
+
+Usage: python tests/_mp_worker_tp.py <coordinator> <num_procs> <proc_id>
+"""
+
+import os
+import sys
+
+if __name__ == "__main__":
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+import jax  # noqa: E402
+
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _to_host(x) -> np.ndarray:
+    """Full global value of a (possibly cross-process-sharded) array."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(x))
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def run_tp_training():
+    """Train a tiny Megatron-TP ViT 3 steps on a [data, model=2] mesh built
+    from ALL global devices; returns (loss, replicated-leaf fingerprint,
+    TP-sharded-leaf fingerprint)."""
+    import jax.numpy as jnp  # noqa: F401
+
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.nn.vit import ViTDef
+    from tpu_dist.train.optim import SGD
+    from tpu_dist.train.state import TrainState
+    from tpu_dist.train.step import make_train_step
+
+    n = jax.device_count()
+    mesh = mesh_lib.device_mesh([n // 2, 2], ["data", "model"])
+    assert mesh_lib.model_axes_intra_host(mesh, ["model"]), (
+        "host-major mesh must keep tp groups intra-host"
+    )
+
+    model = ViTDef(image_size=16, patch_size=4, dim=32, depth=2, heads=4, num_classes=5)
+    specs = model.tp_param_specs("model")
+    opt = SGD()
+    params, s = model.init(jax.random.PRNGKey(0))
+    st = TrainState.create(params, s, opt)
+    state = TrainState(
+        params=mesh_lib.place_host_tree(mesh, st.params, specs),
+        bn_state=mesh_lib.place_host_tree(mesh, st.bn_state),
+        opt_state=mesh_lib.place_host_tree(mesh, st.opt_state, specs),
+        step=mesh_lib.place_host_tree(mesh, st.step),
+    )
+    step = make_train_step(
+        model.apply, opt, mesh, sync_bn=False, donate=False,
+        tp_axis="model", param_specs=specs,
+    )
+
+    rng = np.random.default_rng(0)
+    all_x = rng.normal(size=(16, 16, 16, 3)).astype(np.float32)
+    all_y = rng.integers(0, 5, 16).astype(np.int32)
+    # each process feeds ITS slice of the global batch (host-major rows)
+    per = all_x.shape[0] // jax.process_count()
+    lo = jax.process_index() * per
+    xs = mesh_lib.shard_batch(mesh, all_x[lo:lo + per])
+    ys = mesh_lib.shard_batch(mesh, all_y[lo:lo + per])
+
+    for _ in range(3):
+        state, metrics = step(state, xs, ys, 0.05)
+    loss = float(_to_host(metrics["loss"]))
+    fp_rep = float(_to_host(state.params["patch"]["b"]).sum())
+    fp_tp = float(_to_host(state.params["blocks"][0]["qkv"]["w"]).sum())
+    return loss, fp_rep, fp_tp
+
+
+def main(coordinator: str, num_procs: int, proc_id: int) -> None:
+    from tpu_dist.comm import mesh as mesh_lib
+
+    mesh_lib.initialize_distributed(coordinator, num_procs, proc_id)
+    assert jax.process_count() == num_procs
+    assert jax.local_device_count() == 4
+    loss, fp_rep, fp_tp = run_tp_training()
+    print(f"TPRESULT {proc_id} {loss:.6f} {fp_rep:.6f} {fp_tp:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))

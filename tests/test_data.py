@@ -121,3 +121,20 @@ def test_cifar10_reads_batch_layout(tmp_path):
     assert lbls.tolist() == sum(([i] * 4 for i in range(1, 6)), [])
     with pytest.raises(FileNotFoundError, match="CIFAR-10 not found"):
         load_cifar10(str(tmp_path / "nope"))
+
+
+def test_train_pad_wraps_distinct_samples():
+    """The last partial train batch pads with wrap-around samples from the
+    epoch stream (torch DistributedSampler semantics), not one repeated
+    example (which would give a single image pad× gradient weight)."""
+    mesh = mesh_lib.data_parallel_mesh()
+    # 72 examples, batch 16 -> last batch has 8 real + 8 pad
+    imgs, lbls = synthetic_cifar(72, 10)
+    lbls = np.arange(72).astype(np.int32) % 10  # identifiable labels
+    sampler = DistributedSampler(72, 1, 0, seed=0, shuffle=False)
+    dl = DataLoader(imgs, lbls, 16, sampler, mesh, seed=0, batch_divisor=8)
+    batches = [np.asarray(y) for _, y in dl]
+    last = batches[-1]
+    # tail = first 8 of the epoch stream (wrap-around), not last[7] repeated
+    np.testing.assert_array_equal(last[8:], batches[0][:8])
+    assert not np.all(last[8:] == last[7])

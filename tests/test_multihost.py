@@ -63,3 +63,51 @@ def test_two_process_training_agrees():
     # fused device-resident epoch also agrees across hosts
     assert set(fused) == {"0", "1"}, outs
     assert fused["0"] == fused["1"], fused
+
+
+def test_two_process_tensor_parallel_matches_single_process():
+    """2 hosts × 4 devices, tp=2 on a host-major [data=4, model=2] mesh
+    (VERDICT r1 #6): every tp group intra-host, workers agree with each
+    other AND with the same training run on a single-process 8-device mesh.
+    """
+    _WORKER_TP = os.path.join(os.path.dirname(__file__), "_mp_worker_tp.py")
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo_root
+    env.pop("XLA_FLAGS", None)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER_TP, coord, "2", str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=repo_root,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+        assert p.returncode == 0, out
+
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("TPRESULT"):
+                _, pid, loss, fp_rep, fp_tp = line.split()
+                results[pid] = (loss, fp_rep, fp_tp)
+    assert set(results) == {"0", "1"}, outs
+    assert results["0"] == results["1"], results
+
+    # single-process reference on this test process's own 8-device mesh
+    from tests._mp_worker_tp import run_tp_training
+
+    ref_loss, ref_rep, ref_tp = run_tp_training()
+    loss, fp_rep, fp_tp = (float(v) for v in results["0"])
+    assert abs(loss - ref_loss) < 1e-4, (loss, ref_loss)
+    assert abs(fp_rep - ref_rep) < 1e-4, (fp_rep, ref_rep)
+    assert abs(fp_tp - ref_tp) < 1e-3, (fp_tp, ref_tp)

@@ -1,1 +1,7 @@
-from tpu_dist.ckpt.checkpoint import latest_checkpoint, restore, save, save_best  # noqa: F401
+from tpu_dist.ckpt.checkpoint import (  # noqa: F401
+    latest_checkpoint,
+    read_meta,
+    restore,
+    save,
+    save_best,
+)

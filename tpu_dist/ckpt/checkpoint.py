@@ -61,21 +61,29 @@ def _unflatten(template, flat: dict):
 
 
 def save(
-    ckpt_dir: str, state: TrainState, epoch: int, keep_last: Optional[int] = None
+    ckpt_dir: str,
+    state: TrainState,
+    epoch: int,
+    keep_last: Optional[int] = None,
+    extra_meta: Optional[dict] = None,
 ) -> Optional[str]:
     """Write ``ckpt_{epoch}.npz``; no-op off process 0 (rank-0 guard).
 
-    ``keep_last``: prune to the N newest checkpoints after writing."""
+    ``keep_last``: prune to the N newest checkpoints after writing.
+    ``extra_meta``: extra JSON-serializable keys for the sidecar (e.g. the
+    pipeline layout tag — interleaved storage permutes block order, so a
+    resume under a different ``pp_interleave`` must be refused, not run
+    silently wrong)."""
     # flatten BEFORE the rank-0 guard: gathering cross-process-sharded
     # leaves is collective, so every process must participate
     flat = _flatten(state._asdict())
     if jax.process_index() != 0:
         return None
     os.makedirs(ckpt_dir, exist_ok=True)
-    flat["__meta__"] = np.frombuffer(
-        json.dumps({"epoch": epoch, "step": int(jax.device_get(state.step))}).encode(),
-        dtype=np.uint8,
-    )
+    meta = {"epoch": epoch, "step": int(jax.device_get(state.step))}
+    if extra_meta:
+        meta.update(extra_meta)
+    flat["__meta__"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
     path = os.path.join(ckpt_dir, f"ckpt_{epoch}.npz")
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -95,15 +103,22 @@ def save(
     return path
 
 
-def save_best(ckpt_dir: str, state: TrainState, epoch: int, metric: float) -> Optional[str]:
+def save_best(
+    ckpt_dir: str,
+    state: TrainState,
+    epoch: int,
+    metric: float,
+    extra_meta: Optional[dict] = None,
+) -> Optional[str]:
     """Write/overwrite ``ckpt_best.npz`` (rank-0, atomic) tagging the metric."""
     flat = _flatten(state._asdict())  # collective: before the rank-0 guard
     if jax.process_index() != 0:
         return None
     os.makedirs(ckpt_dir, exist_ok=True)
-    flat["__meta__"] = np.frombuffer(
-        json.dumps({"epoch": epoch, "metric": metric}).encode(), dtype=np.uint8
-    )
+    meta = {"epoch": epoch, "metric": metric}
+    if extra_meta:
+        meta.update(extra_meta)
+    flat["__meta__"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
     path = os.path.join(ckpt_dir, "ckpt_best.npz")
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -124,6 +139,14 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[Tuple[str, int]]:
             if best is None or e > best[1]:
                 best = (os.path.join(ckpt_dir, name), e)
     return best
+
+
+def read_meta(path: str) -> dict:
+    """The JSON sidecar of a checkpoint (epoch, step, any extra_meta)."""
+    with np.load(path) as z:
+        if "__meta__" not in z.files:
+            return {}
+        return json.loads(bytes(z["__meta__"].tobytes()).decode())
 
 
 def restore(path: str, template: TrainState) -> TrainState:

@@ -81,6 +81,20 @@ def is_primary() -> bool:
     return jax.process_index() == 0
 
 
+def host_major_devices(
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> list:
+    """Global device list ordered host-major: all of process 0's devices,
+    then process 1's, ... ``jax.devices()`` is sorted by device id, which on
+    real multi-host TPU does NOT guarantee host grouping — this does. With
+    host-major order, a row-major mesh reshape whose model axes are the
+    TRAILING (fastest-varying) axes keeps each model group on one host
+    whenever the group size divides the local device count, i.e. model
+    collectives ride ICI, never DCN."""
+    devs = list(devices) if devices is not None else jax.devices()
+    return sorted(devs, key=lambda d: (d.process_index, d.id))
+
+
 def device_mesh(
     axis_shapes: Sequence[int],
     axis_names: Sequence[str],
@@ -89,12 +103,14 @@ def device_mesh(
     """Build a named mesh over the slice.
 
     ``axis_shapes`` multiplied together must equal the number of devices.
-    On real TPU hardware ``jax.experimental.mesh_utils`` would pick an
-    ICI-friendly device order; for the 1-D data-parallel meshes this
-    framework's reference scope needs, the default enumeration order is
-    already contiguous over ICI.
+    Devices are laid out HOST-MAJOR (see :func:`host_major_devices`) and the
+    reshape is row-major, so put model-ish axes (tp/ep/pp/sp) LAST: a model
+    group of size ``w`` then spans ``w`` consecutive same-host devices
+    whenever ``w`` divides the local device count — the ICI-vs-DCN split the
+    multi-host design needs. Verify with :func:`model_axes_intra_host`; the
+    Trainer does so and refuses layouts whose model axes would cross hosts.
     """
-    devices = list(devices) if devices is not None else jax.devices()
+    devices = host_major_devices(devices)
     n = int(np.prod(axis_shapes))
     if n != len(devices):
         raise ValueError(
@@ -102,6 +118,23 @@ def device_mesh(
         )
     dev_array = np.array(devices).reshape(tuple(axis_shapes))
     return Mesh(dev_array, tuple(axis_names))
+
+
+def model_axes_intra_host(mesh: Mesh, axes: Sequence[str]) -> bool:
+    """True iff every shard group along ``axes`` lives on a single host —
+    i.e. the collectives over those axes never touch DCN."""
+    names = list(mesh.axis_names)
+    arr = mesh.devices
+    model_idx = [names.index(a) for a in axes]
+    other_idx = [i for i in range(arr.ndim) if i not in model_idx]
+    for pos in np.ndindex(*(arr.shape[i] for i in other_idx)):
+        slicer: list = [slice(None)] * arr.ndim
+        for i, p in zip(other_idx, pos):
+            slicer[i] = p
+        group = arr[tuple(slicer)].ravel()
+        if len({d.process_index for d in group}) > 1:
+            return False
+    return True
 
 
 def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -146,3 +179,31 @@ def _make_global(sharding: NamedSharding, x):
     if jax.process_count() == 1:
         return jax.device_put(x, sharding)
     return jax.make_array_from_process_local_data(sharding, x)
+
+
+def place_host_tree(mesh: Mesh, tree, specs=None):
+    """Place a host (numpy) pytree onto the mesh with per-leaf partition
+    specs (replicated when ``specs`` is None).
+
+    Works single- AND multi-process: single-controller it is a plain
+    ``device_put``; across processes each leaf is assembled with
+    ``make_array_from_callback`` from the FULL host value (every process
+    holds the whole leaf — true for params/opt state initialized from the
+    same seed or restored from the same checkpoint — and materializes only
+    its addressable shards). This is how TP/EP/PP-sharded state gets placed
+    on a multi-host mesh, where ``device_put`` to non-addressable devices
+    is not available.
+    """
+    if specs is None:
+        specs = jax.tree_util.tree_map(lambda _: P(), tree)
+
+    def put(x, spec):
+        sharding = NamedSharding(mesh, spec)
+        if jax.process_count() == 1:
+            # device_put reshards device-resident leaves directly (no
+            # host roundtrip)
+            return jax.device_put(x, sharding)
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+
+    return jax.tree_util.tree_map(put, tree, specs)

@@ -66,6 +66,9 @@ class TrainConfig:
     pp: int = 1                    # pipeline-parallel stages (DPxPP mesh);
                                    # model must support pp_axis (ViT-PP)
     pp_microbatches: int = 0       # 0 = one microbatch per stage
+    pp_interleave: int = 1         # virtual stages per device (Megatron
+                                   # interleaved schedule: bubble shrinks
+                                   # (S-1)/(M+S-1) -> (S-1)/(vM+S-1))
 
     # -- checkpoint / eval cadence -----------------------------------------
     ckpt_dir: Optional[str] = None
@@ -161,6 +164,9 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="pipeline stages (staged ViT)")
     p.add_argument("--pp_microbatches", type=int, default=d.pp_microbatches,
                    help="pipeline microbatches; 0 = one per stage")
+    p.add_argument("--pp_interleave", type=int, default=d.pp_interleave,
+                   help="virtual pipeline stages per device (interleaved "
+                        "schedule; v-fold bubble reduction)")
     p.add_argument("--ckpt_dir", type=str, default=None)
     p.add_argument("--keep_last_ckpts", type=int, default=None)
     p.add_argument("--resume", action="store_true")

@@ -92,8 +92,14 @@ class DataLoader:
             sel = idx[b * self.batch_size : (b + 1) * self.batch_size]
             pad = self.batch_size - len(sel)
             bmask = mask[b * self.batch_size : b * self.batch_size + len(sel)] if self.with_mask else None
-            if pad:  # last partial batch: pad to static shape, mask the tail
-                sel = np.concatenate([sel, np.repeat(sel[-1:], pad)])
+            if pad:
+                # Last partial batch: pad to a static shape with WRAP-AROUND
+                # samples from the start of this shard's epoch stream — the
+                # same semantics as torch's DistributedSampler padding
+                # (distinct examples seen twice, not one example repeated,
+                # so the extra gradient weight is spread like torch's).
+                # Eval (with_mask=True) masks the tail out exactly either way.
+                sel = np.concatenate([sel, np.resize(idx, pad)])
                 if bmask is not None:
                     bmask = np.concatenate([bmask, np.zeros(pad, bool)])
             if self.gather_transform is not None:

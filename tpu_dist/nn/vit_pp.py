@@ -27,13 +27,21 @@ from tpu_dist.nn.vit import (
     check_pos_capacity,
     patchify,
 )
-from tpu_dist.parallel.pipeline import pipeline_apply
+from tpu_dist.parallel.pipeline import pipeline_apply, pipeline_apply_interleaved
 
 
 @dataclass(frozen=True)
 class ViTPipelineDef:
     """Same architecture as :class:`ViTDef` with blocks stored STACKED:
-    every ``params["blocks"]`` leaf has a leading ``depth`` dim."""
+    every ``params["blocks"]`` leaf has a leading ``depth`` dim.
+
+    ``interleave=v > 1`` (with ``pp_stages=S``) selects the interleaved
+    virtual-stage schedule (``pipeline_apply_interleaved``): device ``d``
+    owns the ``v`` non-adjacent virtual stages ``d, d+S, ...``, so the
+    stacked block rows are stored DEVICE-MAJOR (all of device 0's chunks,
+    then device 1's, ...) — one ``P('pipe')`` spec still shards them; the
+    sequential (non-pp) path un-permutes back to logical depth order.
+    """
 
     image_size: int = 32
     patch_size: int = 4
@@ -42,6 +50,8 @@ class ViTPipelineDef:
     heads: int = 4
     mlp_ratio: int = 4
     num_classes: int = 10
+    interleave: int = 1
+    pp_stages: int = 0  # required when interleave > 1 (layout needs S)
 
     @property
     def n_patches(self) -> int:
@@ -54,12 +64,39 @@ class ViTPipelineDef:
             num_classes=self.num_classes,
         )
 
+    def _storage_perm(self):
+        """Block-row permutation logical → storage (device-major chunks).
+        Identity when interleave == 1."""
+        import numpy as np  # noqa: PLC0415
+
+        if self.interleave <= 1:
+            return None
+        n, v = self.pp_stages, self.interleave
+        if n <= 0:
+            raise ValueError("interleave > 1 requires pp_stages (stage count)")
+        if self.depth % (n * v):
+            raise ValueError(
+                f"depth {self.depth} must divide into pp_stages*interleave="
+                f"{n * v} chunks"
+            )
+        bpc = self.depth // (n * v)  # blocks per chunk (virtual stage)
+        rows = []
+        for d in range(n):
+            for k in range(v):
+                j = k * n + d  # logical virtual-stage index
+                rows.extend(range(j * bpc, (j + 1) * bpc))
+        return np.asarray(rows)
+
     def init(self, key, dtype=jnp.float32):
         params, state = self._vit().init(key, dtype)
         blocks = params.pop("blocks")  # list of per-block dicts → stacked
-        params["blocks"] = jax.tree_util.tree_map(
+        stacked = jax.tree_util.tree_map(
             lambda *leaves: jnp.stack(leaves), *blocks
         )
+        perm = self._storage_perm()
+        if perm is not None:
+            stacked = jax.tree_util.tree_map(lambda a: a[perm], stacked)
+        params["blocks"] = stacked
         return params, state
 
     def pp_param_specs(self, axis: str):
@@ -128,22 +165,50 @@ class ViTPipelineDef:
         del axis_name
         t = self._embed(params, x)
         if pp_axis is None:
-            t = self._stage_scan(params["blocks"], t)
+            blocks = params["blocks"]
+            perm = self._storage_perm()
+            if perm is not None:  # storage is device-major — restore logical
+                import numpy as np  # noqa: PLC0415
+
+                inv = np.argsort(perm)
+                blocks = jax.tree_util.tree_map(lambda a: a[inv], blocks)
+            t = self._stage_scan(blocks, t)
             return self._finish(params, t), state
 
         n_stages = lax.axis_size(pp_axis)
+        if self.interleave > 1 and self.pp_stages != n_stages:
+            raise ValueError(
+                f"model laid out for pp_stages={self.pp_stages}, mesh has "
+                f"{n_stages} pipeline stages"
+            )
         m = n_microbatches or n_stages
         b = t.shape[0]
         if b % m:
             raise ValueError(f"batch {b} must divide into {m} microbatches")
         micro = t.reshape(m, b // m, *t.shape[1:])
-        outs = pipeline_apply(
-            lambda blocks, h: self._stage_scan(blocks, h),
-            params["blocks"],
-            micro,
-            pp_axis,
-            n_stages,
-        )
+        if self.interleave > 1:
+            v = self.interleave
+            # local shard rows = this device's v chunks, k-major
+            chunks = jax.tree_util.tree_map(
+                lambda a: a.reshape(v, a.shape[0] // v, *a.shape[1:]),
+                params["blocks"],
+            )
+            outs = pipeline_apply_interleaved(
+                lambda blocks, h: self._stage_scan(blocks, h),
+                chunks,
+                micro,
+                pp_axis,
+                n_stages,
+                v,
+            )
+        else:
+            outs = pipeline_apply(
+                lambda blocks, h: self._stage_scan(blocks, h),
+                params["blocks"],
+                micro,
+                pp_axis,
+                n_stages,
+            )
         t = outs.reshape(b, *t.shape[1:])
         return self._finish(params, t), state
 

@@ -105,6 +105,13 @@ class Trainer:
             num_processes=cfg.num_processes,
             process_id=cfg.process_id,
         )
+        if cfg.pp_interleave < 1:
+            raise ValueError(f"pp_interleave must be >= 1, got {cfg.pp_interleave}")
+        if cfg.pp_interleave > 1 and cfg.pp <= 1:
+            raise ValueError(
+                "pp_interleave > 1 has no effect without pp > 1 — set --pp "
+                "to the stage count (refusing to silently ignore the flag)"
+            )
         combined = sum(w > 1 for w in (cfg.sp, cfg.tp, cfg.ep, cfg.pp))
         if combined > 1 and not (combined == 2 and cfg.sp > 1 and cfg.tp > 1):
             raise ValueError(
@@ -138,6 +145,7 @@ class Trainer:
             )
         else:
             self.mesh = mesh_lib.data_parallel_mesh()
+        self._check_mesh_host_layout()
         # data-parallel width (batch divides over this, not over SP ways)
         self.n_data = int(self.mesh.shape[mesh_lib.DATA_AXIS])
         self.n_devices = int(self.mesh.devices.size)
@@ -212,9 +220,25 @@ class Trainer:
                     f"model {cfg.model!r} does not support pipeline parallelism "
                     f"(no pp_axis in apply); use vit_pp_* or pp=1"
                 )
+            if cfg.pp_interleave > 1:
+                import dataclasses as _dc  # noqa: PLC0415
+
+                m_check = cfg.pp_microbatches or cfg.pp
+                if m_check != cfg.pp:
+                    raise ValueError(
+                        "pp_interleave > 1 requires pp_microbatches == pp "
+                        "(the zero-buffer interleaved schedule)"
+                    )
+                # relay the virtual-stage layout into the model definition
+                self.model = _dc.replace(
+                    self.model, interleave=cfg.pp_interleave, pp_stages=cfg.pp
+                )
             depth = getattr(self.model, "depth", None)
-            if depth is not None and depth % cfg.pp:
-                raise ValueError(f"depth {depth} not divisible by pp={cfg.pp} stages")
+            chunks = cfg.pp * cfg.pp_interleave
+            if depth is not None and depth % chunks:
+                raise ValueError(
+                    f"depth {depth} not divisible by pp*interleave={chunks} chunks"
+                )
             if cfg.fused_epoch or cfg.shard_weight_update:
                 raise ValueError(
                     "pp > 1 is incompatible with fused_epoch / zero1 "
@@ -227,6 +251,13 @@ class Trainer:
                     f"per-data-shard batch {per_dev_batch} must divide into "
                     f"{m} microbatches"
                 )
+            from tpu_dist.parallel.pipeline import bubble_fraction  # noqa: PLC0415
+
+            rank0_print(
+                f"pipeline: {cfg.pp} stages x {cfg.pp_interleave} virtual, "
+                f"{m} microbatches, bubble fraction "
+                f"{bubble_fraction(cfg.pp, m, cfg.pp_interleave):.3f}"
+            )
             self._param_specs = self.model.pp_param_specs(mesh_lib.PIPE_AXIS)
 
         # -- data ------------------------------------------------------------
@@ -403,35 +434,89 @@ class Trainer:
             found = ckpt_lib.latest_checkpoint(cfg.ckpt_dir)
             if found:
                 path, epoch = found
+                self._check_ckpt_layout(path)
                 # template = current state (matches sharded layouts too)
                 restored = ckpt_lib.restore(path, self.state)
                 self.state = self._place_state(restored)
                 self.start_epoch = epoch + 1
                 rank0_print(f"=> resumed from {path} (epoch {epoch})")
 
+    def _ckpt_meta(self) -> dict:
+        """Layout tag written with every checkpoint. Interleaved pipeline
+        storage permutes block order on disk (vit_pp device-major layout), so
+        a ckpt is only loadable under the SAME pp/pp_interleave — the tag
+        lets resume refuse a mismatch instead of silently training with
+        permuted blocks."""
+        cfg = self.cfg
+        return {"pp": cfg.pp, "pp_interleave": cfg.pp_interleave}
+
+    def _check_ckpt_layout(self, path: str) -> None:
+        cfg = self.cfg
+        meta = ckpt_lib.read_meta(path)
+        ck_v = meta.get("pp_interleave")
+        ck_pp = meta.get("pp")
+        if ck_v is None:
+            return  # pre-layout-tag checkpoint: assume non-interleaved
+        if ck_v != cfg.pp_interleave or (
+            (ck_v > 1 or cfg.pp_interleave > 1) and ck_pp != cfg.pp
+        ):
+            raise ValueError(
+                f"checkpoint {path} was written with pp={ck_pp}, "
+                f"pp_interleave={ck_v} — its block storage order is "
+                f"layout-specific; resume with the same flags (got "
+                f"pp={cfg.pp}, pp_interleave={cfg.pp_interleave})"
+            )
+
+    def _check_mesh_host_layout(self) -> None:
+        """Refuse multi-host meshes whose model axes cross hosts: TP/EP/PP
+        collectives must ride ICI, not DCN (SURVEY §2.2 N1; device_mesh
+        builds host-major so any group dividing the local device count is
+        intra-host — this catches the layouts where it can't be)."""
+        if jax.process_count() <= 1:
+            return
+        cfg = self.cfg
+        hard = [
+            a for a, w in (
+                (mesh_lib.MODEL_AXIS, cfg.tp),
+                (mesh_lib.EXPERT_AXIS, cfg.ep),
+                (mesh_lib.PIPE_AXIS, cfg.pp),
+            )
+            if w > 1 and a in self.mesh.axis_names
+        ]
+        if hard and not mesh_lib.model_axes_intra_host(self.mesh, hard):
+            raise ValueError(
+                f"mesh lays model axes {hard} across hosts (DCN): with "
+                f"{jax.local_device_count()} devices/host, keep "
+                f"tp*ep*pp ways a divisor of the local device count"
+            )
+        if (
+            cfg.sp > 1
+            and mesh_lib.SEQ_AXIS in self.mesh.axis_names
+            and not mesh_lib.model_axes_intra_host(self.mesh, [mesh_lib.SEQ_AXIS])
+        ):
+            # ring attention still works over DCN, just slower — warn only
+            rank0_print(
+                "WARNING: sequence-parallel axis spans hosts; ring attention "
+                "will run over DCN instead of ICI"
+            )
+
     def _place_state(self, state: TrainState) -> TrainState:
         """Mesh placement for every supported layout: replicated (default),
         per-leaf TP shardings, ZeRO-1 flat-sharded optimizer state."""
-        from jax.sharding import NamedSharding  # noqa: PLC0415
-
         cfg = self.cfg
         rep = mesh_lib.replicated(self.mesh)
-        if self._param_specs is not None:  # TP
-            place = jax.tree_util.tree_map(
-                lambda leaf, spec: jax.device_put(leaf, NamedSharding(self.mesh, spec)),
-                state.params,
-                self._param_specs,
-            )
-            opt = jax.tree_util.tree_map(
-                lambda leaf, spec: jax.device_put(leaf, NamedSharding(self.mesh, spec)),
-                state.opt_state,
-                self._param_specs,
-            )
+        if self._param_specs is not None:  # TP/EP/PP per-leaf shardings
+            # place_host_tree also covers the multi-host case, where
+            # device_put cannot target non-addressable model shards
             return TrainState(
-                params=place,
-                bn_state=jax.device_put(state.bn_state, rep),
-                opt_state=opt,
-                step=jax.device_put(state.step, rep),
+                params=mesh_lib.place_host_tree(
+                    self.mesh, state.params, self._param_specs
+                ),
+                bn_state=mesh_lib.place_host_tree(self.mesh, state.bn_state),
+                opt_state=mesh_lib.place_host_tree(
+                    self.mesh, state.opt_state, self._param_specs
+                ),
+                step=mesh_lib.place_host_tree(self.mesh, state.step),
             )
         placed = jax.device_put(state, rep)
         if cfg.shard_weight_update:
@@ -575,7 +660,8 @@ class Trainer:
             )
             return
         if not self._in_epoch:
-            ckpt_lib.save(cfg.ckpt_dir, self.state, self._last_epoch, cfg.keep_last_ckpts)
+            ckpt_lib.save(cfg.ckpt_dir, self.state, self._last_epoch,
+                          cfg.keep_last_ckpts, extra_meta=self._ckpt_meta())
             rank0_print(
                 f"=> interrupted after epoch {self._last_epoch} completed; "
                 f"saved as epoch {self._last_epoch}"
@@ -592,7 +678,8 @@ class Trainer:
                 f"already on disk — kept as-is, resume re-runs epoch {self._last_epoch}"
             )
             return
-        ckpt_lib.save(cfg.ckpt_dir, self.state, prev, cfg.keep_last_ckpts)
+        ckpt_lib.save(cfg.ckpt_dir, self.state, prev, cfg.keep_last_ckpts,
+                      extra_meta=self._ckpt_meta())
         rank0_print(
             f"=> interrupted mid-epoch {self._last_epoch}; state saved to "
             f"{cfg.ckpt_dir} as epoch {prev} — resume re-runs epoch "
@@ -633,9 +720,14 @@ class Trainer:
                 history.log("eval", epoch=epoch, top1=t1, top5=t5, loss=vloss)
                 if cfg.ckpt_dir and t1 > best_top1:
                     best_top1 = t1
-                    ckpt_lib.save_best(cfg.ckpt_dir, self.state, epoch, t1)
+                    ckpt_lib.save_best(
+                        cfg.ckpt_dir, self.state, epoch, t1,
+                        extra_meta=self._ckpt_meta(),
+                    )
             if cfg.ckpt_dir and (epoch + 1) % cfg.save_every == 0:
-                ckpt_lib.save(cfg.ckpt_dir, self.state, epoch, cfg.keep_last_ckpts)
+                ckpt_lib.save(cfg.ckpt_dir, self.state, epoch, cfg.keep_last_ckpts,
+                              extra_meta=self._ckpt_meta())
         if cfg.ckpt_dir:
-            ckpt_lib.save(cfg.ckpt_dir, self.state, epochs - 1, cfg.keep_last_ckpts)
+            ckpt_lib.save(cfg.ckpt_dir, self.state, epochs - 1, cfg.keep_last_ckpts,
+                          extra_meta=self._ckpt_meta())
         return last
