@@ -171,3 +171,62 @@ def test_interleave_without_pp_is_refused():
             dataset="synthetic", model="vit_tiny", num_classes=10,
             batch_size=16, pp_interleave=2, sync_bn=False, synthetic_n=160,
         ))
+
+
+def test_untagged_ckpt_refused_by_interleaved_resume(tmp_path):
+    """A pre-layout-tag checkpoint (logical block order) must not be
+    resumed by an interleaved config."""
+    import json
+    import numpy as np
+    import pytest
+    from tpu_dist import ckpt as ckpt_lib
+
+    register_model(
+        "vit_pp_d8c",
+        lambda num_classes=10: ViTPipelineDef(
+            image_size=32, dim=32, depth=8, heads=4, num_classes=num_classes
+        ),
+    )
+    cfg = TrainConfig(
+        dataset="synthetic", model="vit_pp_d8c", num_classes=10, batch_size=16,
+        epochs=1, steps_per_epoch=1, log_every=1, eval_every=0, lr=0.05,
+        pp=4, sync_bn=False, synthetic_n=160,
+        ckpt_dir=str(tmp_path), save_every=1,
+    )
+    Trainer(cfg).fit()
+    # strip the layout tag to simulate an old checkpoint
+    path = ckpt_lib.latest_checkpoint(str(tmp_path))[0]
+    with np.load(path) as z:
+        flat = {k: z[k] for k in z.files}
+    meta = json.loads(bytes(flat["__meta__"].tobytes()).decode())
+    meta.pop("pp_interleave"); meta.pop("pp")
+    flat["__meta__"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+    with open(path, "wb") as f:
+        np.savez(f, **flat)
+
+    with pytest.raises(ValueError, match="no pipeline-layout tag"):
+        Trainer(cfg.replace(resume=True, pp_interleave=2))
+
+
+def test_interleave_on_unsupporting_model_is_refused():
+    """A registered pp-capable model without interleave fields gets a clean
+    ValueError, not a dataclasses TypeError."""
+    import pytest
+
+    class PPButNoInterleave:
+        depth = 4
+        def init(self, key):  # pragma: no cover - never reached
+            raise NotImplementedError
+        def apply(self, params, state, x, *, train=False, axis_name=None,
+                  pp_axis=None, n_microbatches=0):  # pragma: no cover
+            raise NotImplementedError
+        def pp_param_specs(self, axis):  # pragma: no cover
+            raise NotImplementedError
+
+    register_model("pp_no_ilv", lambda num_classes=10: PPButNoInterleave())
+    with pytest.raises(ValueError, match="interleaved schedule"):
+        Trainer(TrainConfig(
+            dataset="synthetic", model="pp_no_ilv", num_classes=10,
+            batch_size=16, pp=4, pp_interleave=2, sync_bn=False,
+            synthetic_n=160,
+        ))

@@ -229,6 +229,16 @@ class Trainer:
                         "pp_interleave > 1 requires pp_microbatches == pp "
                         "(the zero-buffer interleaved schedule)"
                     )
+                if not (
+                    _dc.is_dataclass(self.model)
+                    and hasattr(self.model, "interleave")
+                    and hasattr(self.model, "pp_stages")
+                ):
+                    raise ValueError(
+                        f"model {cfg.model!r} does not support the interleaved "
+                        f"schedule (no interleave/pp_stages fields); use "
+                        f"vit_pp_* or pp_interleave=1"
+                    )
                 # relay the virtual-stage layout into the model definition
                 self.model = _dc.replace(
                     self.model, interleave=cfg.pp_interleave, pp_stages=cfg.pp
@@ -456,7 +466,15 @@ class Trainer:
         ck_v = meta.get("pp_interleave")
         ck_pp = meta.get("pp")
         if ck_v is None:
-            return  # pre-layout-tag checkpoint: assume non-interleaved
+            # pre-layout-tag checkpoint: blocks are in logical depth order —
+            # loadable only by non-interleaved configs
+            if cfg.pp_interleave > 1:
+                raise ValueError(
+                    f"checkpoint {path} has no pipeline-layout tag (written "
+                    f"before interleaving existed, logical block order) — it "
+                    f"cannot be resumed with pp_interleave={cfg.pp_interleave}"
+                )
+            return
         if ck_v != cfg.pp_interleave or (
             (ck_v > 1 or cfg.pp_interleave > 1) and ck_pp != cfg.pp
         ):
