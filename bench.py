@@ -300,6 +300,12 @@ def main() -> None:
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--warmup", type=int, default=10)
     p.add_argument(
+        "--batch_size", type=int, default=0,
+        help="override the config's global batch (0 = config default); "
+             "probing the throughput/MFU-vs-batch curve without editing "
+             "CONFIGS",
+    )
+    p.add_argument(
         "--init_timeout", type=float,
         default=float(os.environ.get("BENCH_INIT_TIMEOUT", "600")),
     )
@@ -315,6 +321,15 @@ def main() -> None:
              "by visible devices)",
     )
     args = p.parse_args()
+    if args.batch_size:
+        import dataclasses
+
+        CONFIGS.update(
+            {
+                name: dataclasses.replace(c, global_batch=args.batch_size)
+                for name, c in CONFIGS.items()
+            }
+        )
 
     # persistent XLA compile cache: repeat bench invocations skip the
     # ~20-40s first-compile cost

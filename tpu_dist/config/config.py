@@ -84,6 +84,8 @@ class TrainConfig:
                                    # (docs in train/epoch.py; small datasets)
     shard_weight_update: bool = False  # ZeRO-1 weight-update sharding
                                        # (arXiv:2004.13336; train/step.py)
+    fsdp: bool = False             # fully-sharded (ZeRO-3) params+momentum
+                                   # via GSPMD (parallel/fsdp.py)
     fused_optimizer: bool = False  # Pallas fused SGD kernel (ops/fused_sgd.py)
     remat: bool = False            # jax.checkpoint the forward (less memory)
 
@@ -135,6 +137,9 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="device-resident data: one jit call per epoch")
     p.add_argument("--shard_weight_update", "--zero1", action="store_true",
                    help="ZeRO-1 weight-update sharding (arXiv:2004.13336)")
+    p.add_argument("--fsdp", action="store_true",
+                   help="fully-sharded data parallelism (ZeRO-3): params and "
+                        "momentum sharded over the data axis via GSPMD")
     p.add_argument("--fused_optimizer", action="store_true",
                    help="Pallas fused SGD kernel")
     p.add_argument("--remat", action="store_true",

@@ -173,6 +173,37 @@ class Trainer:
                     f"evaluation sharding"
                 )
         self._param_specs = None
+        self._fsdp_specs = None
+        if cfg.fsdp:
+            if cfg.sp > 1 or cfg.tp > 1 or cfg.ep > 1 or cfg.pp > 1:
+                raise ValueError(
+                    "fsdp shards params/momentum over the data axis; it does "
+                    "not compose with sp/tp/ep/pp model axes"
+                )
+            if cfg.fused_epoch or cfg.shard_weight_update:
+                raise ValueError(
+                    "fsdp is incompatible with fused_epoch / zero1 (fsdp "
+                    "supersedes ZeRO-1: momentum AND params are sharded)"
+                )
+            if cfg.fused_optimizer:
+                raise ValueError(
+                    "fsdp uses the plain SGD update (XLA fuses it into the "
+                    "sharded program); fused_optimizer is shard_map-path only"
+                )
+            if not cfg.sync_bn:
+                # not an error: BN-free models (ViT) legitimately pass
+                # sync_bn=False; for BN models the flag simply cannot take
+                # effect under GSPMD's global-batch semantics
+                rank0_print(
+                    "WARNING: --no_sync_bn has no effect under --fsdp — "
+                    "BatchNorm statistics are global-batch (SyncBN) by "
+                    "construction in the GSPMD engine"
+                )
+            if cfg.debug_replica_check:
+                raise ValueError(
+                    "debug_replica_check asserts replicated params; under "
+                    "fsdp params are sharded by design"
+                )
         if cfg.tp > 1:
             import inspect  # noqa: PLC0415
 
@@ -372,6 +403,10 @@ class Trainer:
         )
         params, bn_state = self.model.init(jax.random.PRNGKey(seed))
         state = TrainState.create(params, bn_state, self.optimizer)
+        if cfg.fsdp:
+            from tpu_dist.parallel.fsdp import fsdp_specs  # noqa: PLC0415
+
+            self._fsdp_specs = fsdp_specs(params, self.mesh)
         if cfg.shard_weight_update and cfg.fused_epoch:
             raise ValueError("shard_weight_update is not supported with fused_epoch yet")
         # place on the mesh (DDP's init-time param broadcast; sharded
@@ -383,33 +418,34 @@ class Trainer:
             self.lr_schedule = multistep_lr(cfg.lr, cfg.lr_milestones, cfg.lr_gamma)
 
         compute_dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
-        self.train_step = make_train_step(
-            self.model.apply, self.optimizer, self.mesh,
-            grad_accum_steps=cfg.grad_accu_steps,
-            sync_bn=cfg.sync_bn,
-            compute_dtype=compute_dtype,
-            shard_weight_update=cfg.shard_weight_update,
-            label_smoothing=cfg.label_smoothing,
-            grad_clip_norm=cfg.grad_clip_norm,
-            seq_axis=mesh_lib.SEQ_AXIS if cfg.sp > 1 else None,
-            tp_axis=mesh_lib.MODEL_AXIS if cfg.tp > 1 else None,
-            ep_axis=mesh_lib.EXPERT_AXIS if cfg.ep > 1 else None,
-            pp_axis=mesh_lib.PIPE_AXIS if cfg.pp > 1 else None,
-            param_specs=self._param_specs,
-            remat=cfg.remat,
-            model_kwargs=(
-                {"n_microbatches": cfg.pp_microbatches}
-                if cfg.pp > 1 and cfg.pp_microbatches
-                else None
-            ),
-        )
-        self.eval_step = make_eval_step(
-            self.model.apply, self.mesh, compute_dtype=compute_dtype, axis=eval_axes,
-            tp_axis=mesh_lib.MODEL_AXIS if cfg.tp > 1 else None,
-            ep_axis=mesh_lib.EXPERT_AXIS if cfg.ep > 1 else None,
-            pp_axis=mesh_lib.PIPE_AXIS if cfg.pp > 1 else None,
-            param_specs=self._param_specs,
-        )
+        if cfg.fsdp:
+            from tpu_dist.parallel.fsdp import (  # noqa: PLC0415
+                make_fsdp_eval_step,
+                make_fsdp_train_step,
+            )
+
+            self.train_step = make_fsdp_train_step(
+                self.model.apply, self.optimizer, self.mesh, self._fsdp_specs,
+                grad_accum_steps=cfg.grad_accu_steps,
+                compute_dtype=compute_dtype,
+                label_smoothing=cfg.label_smoothing,
+                grad_clip_norm=cfg.grad_clip_norm,
+                remat=cfg.remat,
+            )
+            self.eval_step = make_fsdp_eval_step(
+                self.model.apply, self.mesh, self._fsdp_specs,
+                compute_dtype=compute_dtype,
+            )
+        else:
+            self.train_step = self._build_train_step(cfg, compute_dtype)
+            self.eval_step = make_eval_step(
+                self.model.apply, self.mesh, compute_dtype=compute_dtype,
+                axis=eval_axes,
+                tp_axis=mesh_lib.MODEL_AXIS if cfg.tp > 1 else None,
+                ep_axis=mesh_lib.EXPERT_AXIS if cfg.ep > 1 else None,
+                pp_axis=mesh_lib.PIPE_AXIS if cfg.pp > 1 else None,
+                param_specs=self._param_specs,
+            )
 
         self._fused_runner = None
         if cfg.fused_epoch:
@@ -450,6 +486,28 @@ class Trainer:
                 self.state = self._place_state(restored)
                 self.start_epoch = epoch + 1
                 rank0_print(f"=> resumed from {path} (epoch {epoch})")
+
+    def _build_train_step(self, cfg: TrainConfig, compute_dtype):
+        return make_train_step(
+            self.model.apply, self.optimizer, self.mesh,
+            grad_accum_steps=cfg.grad_accu_steps,
+            sync_bn=cfg.sync_bn,
+            compute_dtype=compute_dtype,
+            shard_weight_update=cfg.shard_weight_update,
+            label_smoothing=cfg.label_smoothing,
+            grad_clip_norm=cfg.grad_clip_norm,
+            seq_axis=mesh_lib.SEQ_AXIS if cfg.sp > 1 else None,
+            tp_axis=mesh_lib.MODEL_AXIS if cfg.tp > 1 else None,
+            ep_axis=mesh_lib.EXPERT_AXIS if cfg.ep > 1 else None,
+            pp_axis=mesh_lib.PIPE_AXIS if cfg.pp > 1 else None,
+            param_specs=self._param_specs,
+            remat=cfg.remat,
+            model_kwargs=(
+                {"n_microbatches": cfg.pp_microbatches}
+                if cfg.pp > 1 and cfg.pp_microbatches
+                else None
+            ),
+        )
 
     def _ckpt_meta(self) -> dict:
         """Layout tag written with every checkpoint. Interleaved pipeline
@@ -523,6 +581,17 @@ class Trainer:
         per-leaf TP shardings, ZeRO-1 flat-sharded optimizer state."""
         cfg = self.cfg
         rep = mesh_lib.replicated(self.mesh)
+        if self._fsdp_specs is not None:  # FSDP: params+momentum data-sharded
+            return TrainState(
+                params=mesh_lib.place_host_tree(
+                    self.mesh, state.params, self._fsdp_specs
+                ),
+                bn_state=mesh_lib.place_host_tree(self.mesh, state.bn_state),
+                opt_state=mesh_lib.place_host_tree(
+                    self.mesh, state.opt_state, self._fsdp_specs
+                ),
+                step=mesh_lib.place_host_tree(self.mesh, state.step),
+            )
         if self._param_specs is not None:  # TP/EP/PP per-leaf shardings
             # place_host_tree also covers the multi-host case, where
             # device_put cannot target non-addressable model shards
