@@ -97,6 +97,7 @@ class BenchConfig:
     grad_accum: int = 1
     sync_bn: bool = True
     fused_epoch: bool = False  # device-resident data, one jit per epoch
+    flash: bool = False        # Pallas tiled attention (transformer models)
     epoch_images: int = CIFAR_TRAIN  # for sec/epoch derivation
 
 
@@ -114,6 +115,10 @@ CONFIGS = {
         BenchConfig(
             "vit_b16_imagenet", "vit_b16", 224, 1000, 64,
             sync_bn=False, epoch_images=1_281_167,
+        ),
+        BenchConfig(
+            "vit_b16_imagenet_flash", "vit_b16", 224, 1000, 64,
+            sync_bn=False, flash=True, epoch_images=1_281_167,
         ),
     ]
 }
@@ -136,6 +141,10 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None)
         "resnet50_imagenet": resnet50_imagenet,
         "vit_b16": lambda num_classes: vit_b16(num_classes, cfg.image_size),
     }
+    from tpu_dist.nn.attention import set_default_attention_impl
+
+    # process-global: reset per run so --all mixes flash/xla configs safely
+    set_default_attention_impl("flash" if cfg.flash else "xla")
     if n_devices is None:
         mesh = mesh_lib.data_parallel_mesh()
     else:

@@ -87,6 +87,8 @@ class TrainConfig:
     fsdp: bool = False             # fully-sharded (ZeRO-3) params+momentum
                                    # via GSPMD (parallel/fsdp.py)
     fused_optimizer: bool = False  # Pallas fused SGD kernel (ops/fused_sgd.py)
+    flash_attention: bool = False  # Pallas tiled attention (ops/flash_attention.py)
+                                   # for transformer models; process-global
     remat: bool = False            # jax.checkpoint the forward (less memory)
 
     # -- bench / smoke / debug ---------------------------------------------
@@ -142,6 +144,9 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "momentum sharded over the data axis via GSPMD")
     p.add_argument("--fused_optimizer", action="store_true",
                    help="Pallas fused SGD kernel")
+    p.add_argument("--flash_attention", action="store_true",
+                   help="Pallas tiled (flash) attention for transformer "
+                        "models — O(block^2) memory instead of O(S^2)")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint the forward (less activation memory)")
     p.add_argument("--no_sync_bn", dest="sync_bn", action="store_false",

@@ -27,9 +27,39 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# Process-global default for the single-device attention implementation.
+# "xla": one fused einsum/softmax chain ([S,S] scores in HBM — fine at ViT
+# lengths). "flash": the Pallas tiled kernel (ops/flash_attention.py) —
+# O(block²) memory, the long-context choice. The Trainer sets this from
+# ``--flash_attention``; it is process-global state like the XLA compile
+# cache, not per-model.
+_DEFAULT_IMPL = "xla"
 
-def full_attention(q, k, v, *, causal: bool = False):
+
+def set_default_attention_impl(impl: str) -> None:
+    global _DEFAULT_IMPL
+    if impl not in ("xla", "flash"):
+        raise ValueError(f"attention impl must be 'xla' or 'flash', got {impl!r}")
+    _DEFAULT_IMPL = impl
+
+
+def get_default_attention_impl() -> str:
+    return _DEFAULT_IMPL
+
+
+def _resolve_impl(impl: Optional[str]) -> str:
+    impl = impl or _DEFAULT_IMPL
+    if impl not in ("xla", "flash"):
+        raise ValueError(f"attention impl must be 'xla' or 'flash', got {impl!r}")
+    return impl
+
+
+def full_attention(q, k, v, *, causal: bool = False, impl: Optional[str] = None):
     """[B,S,H,D] x3 → [B,S,H,D]. Softmax in f32 regardless of input dtype."""
+    if _resolve_impl(impl) == "flash":
+        from tpu_dist.ops.flash_attention import flash_attention  # noqa: PLC0415
+
+        return flash_attention(q, k, v, causal=causal)
     d = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
     scores = scores / jnp.sqrt(jnp.float32(d))
@@ -100,8 +130,28 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False):
     return out.transpose(0, 2, 1, 3).astype(q.dtype)               # [B,Sq,H,D]
 
 
-def attention(q, k, v, *, causal: bool = False, seq_axis: Optional[str] = None):
-    """Dispatch: ring attention when a sequence axis is given, else full."""
+_warned_flash_ring = False
+
+
+def attention(q, k, v, *, causal: bool = False, seq_axis: Optional[str] = None,
+              impl: Optional[str] = None):
+    """Dispatch: ring attention when a sequence axis is given, else full
+    (``impl``/module default selecting XLA vs Pallas flash).
+
+    Under a ``seq_axis`` the Pallas kernel does not apply (the ring is its
+    own blockwise online softmax — it never materializes a global [S, S];
+    each rotation computes one [S/n, S/n] local tile): a flash request is
+    acknowledged with a one-time warning rather than silently honored."""
     if seq_axis is not None:
+        if _resolve_impl(impl) == "flash":
+            global _warned_flash_ring
+            if not _warned_flash_ring:
+                _warned_flash_ring = True
+                print(
+                    "tpu_dist: NOTE — flash attention impl does not apply under "
+                    "sequence parallelism; using ring attention (itself "
+                    "blockwise online-softmax, no global [S,S] materialized)",
+                    flush=True,
+                )
         return ring_attention(q, k, v, seq_axis, causal=causal)
-    return full_attention(q, k, v, causal=causal)
+    return full_attention(q, k, v, causal=causal, impl=impl)

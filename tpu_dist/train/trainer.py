@@ -149,6 +149,13 @@ class Trainer:
         # data-parallel width (batch divides over this, not over SP ways)
         self.n_data = int(self.mesh.shape[mesh_lib.DATA_AXIS])
         self.n_devices = int(self.mesh.devices.size)
+        from tpu_dist.nn.attention import (  # noqa: PLC0415
+            set_default_attention_impl,
+        )
+
+        # set BOTH directions: the default is process-global, and a later
+        # Trainer in the same process must not inherit a stale 'flash'
+        set_default_attention_impl("flash" if cfg.flash_attention else "xla")
         self.model = build_model(cfg)
         if cfg.sp > 1:
             import inspect  # noqa: PLC0415
