@@ -1,4 +1,5 @@
 from tpu_dist.ckpt.checkpoint import (  # noqa: F401
+    AsyncCheckpointer,
     latest_checkpoint,
     read_meta,
     restore,

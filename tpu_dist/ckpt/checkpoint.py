@@ -60,6 +60,34 @@ def _unflatten(template, flat: dict):
     return jax.tree_util.tree_unflatten(treedef, [l for l in leaves])
 
 
+def _write_npz(
+    ckpt_dir: str, name: str, flat: dict, meta: dict,
+    keep_last: Optional[int] = None,
+) -> str:
+    """Serialize + atomically publish one checkpoint file (host-side only —
+    safe to run on a worker thread; ``flat`` holds host numpy copies)."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    flat = dict(flat)
+    flat["__meta__"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+    path = os.path.join(ckpt_dir, name)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **flat)
+    os.replace(tmp, path)  # atomic: a ckpt file is either absent or complete
+    if keep_last is not None and keep_last > 0:
+        epochs = sorted(
+            int(m.group(1))
+            for m in (_CKPT_RE.search(n) for n in os.listdir(ckpt_dir))
+            if m
+        )
+        for e in epochs[:-keep_last]:
+            try:
+                os.remove(os.path.join(ckpt_dir, f"ckpt_{e}.npz"))
+            except OSError:
+                pass
+    return path
+
+
 def save(
     ckpt_dir: str,
     state: TrainState,
@@ -79,28 +107,10 @@ def save(
     flat = _flatten(state._asdict())
     if jax.process_index() != 0:
         return None
-    os.makedirs(ckpt_dir, exist_ok=True)
     meta = {"epoch": epoch, "step": int(jax.device_get(state.step))}
     if extra_meta:
         meta.update(extra_meta)
-    flat["__meta__"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
-    path = os.path.join(ckpt_dir, f"ckpt_{epoch}.npz")
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **flat)
-    os.replace(tmp, path)  # atomic: a ckpt file is either absent or complete
-    if keep_last is not None and keep_last > 0:
-        epochs = sorted(
-            int(m.group(1))
-            for m in (_CKPT_RE.search(n) for n in os.listdir(ckpt_dir))
-            if m
-        )
-        for e in epochs[:-keep_last]:
-            try:
-                os.remove(os.path.join(ckpt_dir, f"ckpt_{e}.npz"))
-            except OSError:
-                pass
-    return path
+    return _write_npz(ckpt_dir, f"ckpt_{epoch}.npz", flat, meta, keep_last)
 
 
 def save_best(
@@ -114,17 +124,101 @@ def save_best(
     flat = _flatten(state._asdict())  # collective: before the rank-0 guard
     if jax.process_index() != 0:
         return None
-    os.makedirs(ckpt_dir, exist_ok=True)
     meta = {"epoch": epoch, "metric": metric}
     if extra_meta:
         meta.update(extra_meta)
-    flat["__meta__"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
-    path = os.path.join(ckpt_dir, "ckpt_best.npz")
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **flat)
-    os.replace(tmp, path)
-    return path
+    return _write_npz(ckpt_dir, "ckpt_best.npz", flat, meta)
+
+
+class AsyncCheckpointer:
+    """Overlap checkpoint WRITES with training (the orbax-style async-save
+    pattern, self-contained).
+
+    The device→host snapshot (``_flatten``) stays synchronous — it is the
+    data dependency on the live ``TrainState`` and, multi-host, a
+    collective every process must join. The expensive part (npz
+    serialization + atomic rename + pruning) runs on a single worker
+    thread over the host copies, so the train loop resumes immediately.
+
+    Publish order is the submission order (one worker thread). A save
+    never blocks on an earlier write still in flight — it only harvests
+    ALREADY-finished writes to surface their errors; ``wait()`` blocks on
+    everything outstanding and re-raises the first writer error. Call
+    ``wait()`` (or ``close()``, which also releases the worker thread)
+    before process exit — the Trainer does, at the end of ``fit()`` and in
+    the interrupt path.
+    """
+
+    def __init__(self) -> None:
+        from concurrent.futures import ThreadPoolExecutor  # noqa: PLC0415
+
+        self._pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="ckpt")
+        self._pending: list = []
+
+    def _harvest(self, block: bool) -> None:
+        first_err = None
+        while self._pending and (block or self._pending[0].done()):
+            fut = self._pending.pop(0)
+            try:
+                fut.result()
+            except Exception as e:  # keep draining; re-raise the first
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+
+    def wait(self) -> None:
+        """Block until every outstanding write is published; re-raises the
+        first writer-thread exception here."""
+        self._harvest(block=True)
+
+    def close(self) -> None:
+        """``wait()`` then release the worker thread. The instance is dead
+        afterwards (a new save would raise from the shut-down pool)."""
+        try:
+            self.wait()
+        finally:
+            self._pool.shutdown(wait=True)
+
+    def save(
+        self,
+        ckpt_dir: str,
+        state: TrainState,
+        epoch: int,
+        keep_last: Optional[int] = None,
+        extra_meta: Optional[dict] = None,
+    ) -> Optional[str]:
+        flat = _flatten(state._asdict())  # sync: collective + host snapshot
+        if jax.process_index() != 0:
+            return None
+        self._harvest(block=False)  # surface finished writes' errors only
+        meta = {"epoch": epoch, "step": int(jax.device_get(state.step))}
+        if extra_meta:
+            meta.update(extra_meta)
+        self._pending.append(self._pool.submit(
+            _write_npz, ckpt_dir, f"ckpt_{epoch}.npz", flat, meta, keep_last
+        ))
+        return os.path.join(ckpt_dir, f"ckpt_{epoch}.npz")
+
+    def save_best(
+        self,
+        ckpt_dir: str,
+        state: TrainState,
+        epoch: int,
+        metric: float,
+        extra_meta: Optional[dict] = None,
+    ) -> Optional[str]:
+        flat = _flatten(state._asdict())
+        if jax.process_index() != 0:
+            return None
+        self._harvest(block=False)
+        meta = {"epoch": epoch, "metric": metric}
+        if extra_meta:
+            meta.update(extra_meta)
+        self._pending.append(self._pool.submit(
+            _write_npz, ckpt_dir, "ckpt_best.npz", flat, meta
+        ))
+        return os.path.join(ckpt_dir, "ckpt_best.npz")
 
 
 def latest_checkpoint(ckpt_dir: str) -> Optional[Tuple[str, int]]:

@@ -75,6 +75,8 @@ class TrainConfig:
     save_every: int = 15           # dead utils/config.py:7 'save_epoch', made real
     keep_last_ckpts: Optional[int] = None  # prune to N newest (None = keep all)
     resume: bool = False
+    async_ckpt: bool = False       # overlap ckpt npz writes with training
+                                   # (ckpt/checkpoint.py::AsyncCheckpointer)
     eval_every: int = 1
     log_every: int = 20
     log_file: Optional[str] = None # JSONL metrics history (rank 0)
@@ -180,6 +182,9 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--ckpt_dir", type=str, default=None)
     p.add_argument("--keep_last_ckpts", type=int, default=None)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--async_ckpt", action="store_true",
+                   help="write checkpoints on a background thread (training "
+                        "continues during the npz serialization)")
     p.add_argument("--log_file", type=str, default=None,
                    help="JSONL metrics history path (rank 0)")
     p.add_argument("--eval_every", type=int, default=d.eval_every,

@@ -482,6 +482,7 @@ class Trainer:
                 compute_dtype=compute_dtype, **stats,
             )
 
+        self._async_ckpt = ckpt_lib.AsyncCheckpointer() if cfg.async_ckpt else None
         self.start_epoch = 0
         if cfg.resume and cfg.ckpt_dir:
             found = ckpt_lib.latest_checkpoint(cfg.ckpt_dir)
@@ -493,6 +494,34 @@ class Trainer:
                 self.state = self._place_state(restored)
                 self.start_epoch = epoch + 1
                 rank0_print(f"=> resumed from {path} (epoch {epoch})")
+
+    def _ckpt_io(self):
+        """Sync module functions or the async writer (``--async_ckpt``);
+        the writer is created lazily so each ``fit()`` gets a fresh pool
+        after ``_ckpt_close()`` released the previous worker thread."""
+        if not self.cfg.async_ckpt:
+            return ckpt_lib
+        if self._async_ckpt is None:
+            self._async_ckpt = ckpt_lib.AsyncCheckpointer()
+        return self._async_ckpt
+
+    def _ckpt_wait(self) -> None:
+        if self._async_ckpt is not None:
+            self._async_ckpt.wait()
+
+    def _ckpt_close(self, suppress: bool = False) -> None:
+        """Drain + release the async writer. ``suppress=True`` logs a
+        writer error instead of raising — for paths where an exception is
+        already propagating (interrupt/divergence) and must not be masked."""
+        if self._async_ckpt is None:
+            return
+        writer, self._async_ckpt = self._async_ckpt, None
+        try:
+            writer.close()
+        except Exception as e:
+            if not suppress:
+                raise
+            rank0_print(f"WARNING: background checkpoint write failed: {e}")
 
     def _build_train_step(self, cfg: TrainConfig, compute_dtype):
         return make_train_step(
@@ -718,10 +747,17 @@ class Trainer:
         self._last_epoch = self.start_epoch
         self._in_epoch = False
         try:
-            return self._fit_loop(epochs, history, last)
+            result = self._fit_loop(epochs, history, last)
+            self._ckpt_close()  # success path: writer errors RAISE here
+            return result
         except KeyboardInterrupt:
             self._emergency_save()
             raise
+        finally:
+            # error exits (divergence, interrupt): still drain in-flight
+            # writes, but log writer failures rather than mask the
+            # propagating exception
+            self._ckpt_close(suppress=True)
 
     def _emergency_save(self) -> None:
         """Ctrl-C snapshot discipline.
@@ -743,6 +779,11 @@ class Trainer:
         cfg = self.cfg
         if not cfg.ckpt_dir:
             return
+        # drain any in-flight async write FIRST (host-local, not collective —
+        # safe before the sharded-state guard): the emergency snapshot must be
+        # the LAST file published, and a writer error must not abort the
+        # snapshot or mask the interrupt
+        self._ckpt_close(suppress=True)
         if jax.process_count() > 1 and any(
             isinstance(l, jax.Array) and not l.is_fully_addressable
             for l in jax.tree_util.tree_leaves(self.state._asdict())
@@ -814,14 +855,18 @@ class Trainer:
                 history.log("eval", epoch=epoch, top1=t1, top5=t5, loss=vloss)
                 if cfg.ckpt_dir and t1 > best_top1:
                     best_top1 = t1
-                    ckpt_lib.save_best(
+                    self._ckpt_io().save_best(
                         cfg.ckpt_dir, self.state, epoch, t1,
                         extra_meta=self._ckpt_meta(),
                     )
             if cfg.ckpt_dir and (epoch + 1) % cfg.save_every == 0:
-                ckpt_lib.save(cfg.ckpt_dir, self.state, epoch, cfg.keep_last_ckpts,
-                              extra_meta=self._ckpt_meta())
+                self._ckpt_io().save(
+                    cfg.ckpt_dir, self.state, epoch, cfg.keep_last_ckpts,
+                    extra_meta=self._ckpt_meta(),
+                )
         if cfg.ckpt_dir:
-            ckpt_lib.save(cfg.ckpt_dir, self.state, epochs - 1, cfg.keep_last_ckpts,
-                          extra_meta=self._ckpt_meta())
-        return last
+            self._ckpt_io().save(
+                cfg.ckpt_dir, self.state, epochs - 1, cfg.keep_last_ckpts,
+                extra_meta=self._ckpt_meta(),
+            )
+        return last  # fit() drains the async writer before returning
