@@ -1,0 +1,100 @@
+"""Worker for the multi-host × expert-parallel test.
+
+Launched by tests/test_multihost.py as 2 processes × 4 CPU devices: one
+8-device global mesh laid out ``[data=4, expert=2]`` HOST-MAJOR, so each
+ep=2 expert group (and its all_to_all dispatch) is intra-host — the
+ICI side of the ICI/DCN split. The same ``run_ep_training`` also runs
+in the parent test in-process (1 × 8 devices) as the reference; loss,
+replicated leaves and expert-sharded leaves must agree across layouts.
+
+Usage: python tests/_mp_worker_ep.py <coordinator> <num_procs> <proc_id>
+"""
+
+import os
+import sys
+
+if __name__ == "__main__":
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+import jax  # noqa: E402
+
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _to_host(x) -> np.ndarray:
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(x))
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def run_ep_training():
+    """Train the tiny MoE ViT 3 steps on a [data, expert=2] mesh over ALL
+    global devices; returns (loss, replicated fingerprint, expert-sharded
+    fingerprint)."""
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.nn.vit_moe import vit_moe_tiny
+    from tpu_dist.train.optim import SGD
+    from tpu_dist.train.state import TrainState
+    from tpu_dist.train.step import make_train_step
+
+    n = jax.device_count()
+    mesh = mesh_lib.device_mesh([n // 2, 2], ["data", "expert"])
+    assert mesh_lib.model_axes_intra_host(mesh, ["expert"]), (
+        "host-major mesh must keep expert groups intra-host"
+    )
+
+    model = vit_moe_tiny(num_classes=5)
+    specs = model.ep_param_specs("expert")
+    opt = SGD()
+    params, s = model.init(jax.random.PRNGKey(0))
+    st = TrainState.create(params, s, opt)
+    state = TrainState(
+        params=mesh_lib.place_host_tree(mesh, st.params, specs),
+        bn_state=mesh_lib.place_host_tree(mesh, st.bn_state),
+        opt_state=mesh_lib.place_host_tree(mesh, st.opt_state, specs),
+        step=mesh_lib.place_host_tree(mesh, st.step),
+    )
+    step = make_train_step(
+        model.apply, opt, mesh, sync_bn=False, donate=False,
+        ep_axis="expert", param_specs=specs,
+    )
+
+    rng = np.random.default_rng(0)
+    all_x = rng.normal(size=(16, 32, 32, 3)).astype(np.float32)
+    all_y = rng.integers(0, 5, 16).astype(np.int32)
+    # under ep>1 the batch shards over EVERY device ([data, expert] axes);
+    # each process feeds its host-major slice of the global batch
+    per = all_x.shape[0] // jax.process_count()
+    lo = jax.process_index() * per
+    axes = ("data", "expert")
+    xs = mesh_lib.shard_batch(mesh, all_x[lo:lo + per], axis=axes)
+    ys = mesh_lib.shard_batch(mesh, all_y[lo:lo + per], axis=axes)
+
+    for _ in range(3):
+        state, metrics = step(state, xs, ys, 0.05)
+    loss = float(_to_host(metrics["loss"]))
+    fp_rep = float(_to_host(state.params["patch"]["b"]).sum())
+    # an expert-sharded leaf: first block's expert MLP input weights
+    fp_ep = float(_to_host(state.params["blocks"][0]["moe"]["w_in"]).sum())
+    return loss, fp_rep, fp_ep
+
+
+def main(coordinator: str, num_procs: int, proc_id: int) -> None:
+    from tpu_dist.comm import mesh as mesh_lib
+
+    mesh_lib.initialize_distributed(coordinator, num_procs, proc_id)
+    assert jax.process_count() == num_procs
+    assert jax.local_device_count() == 4
+    loss, fp_rep, fp_ep = run_ep_training()
+    print(f"EPRESULT {proc_id} {loss:.6f} {fp_rep:.6f} {fp_ep:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))

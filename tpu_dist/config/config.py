@@ -80,6 +80,9 @@ class TrainConfig:
     eval_every: int = 1
     log_every: int = 20
     log_file: Optional[str] = None # JSONL metrics history (rank 0)
+    tensorboard_dir: Optional[str] = None  # the reference's dead
+                                   # utils/config.py:8 knob, made real
+                                   # (metrics/tensorboard.py, rank 0)
 
     # -- TPU fast path -------------------------------------------------------
     fused_epoch: bool = False      # device-resident data, one jit per epoch
@@ -187,6 +190,10 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "continues during the npz serialization)")
     p.add_argument("--log_file", type=str, default=None,
                    help="JSONL metrics history path (rank 0)")
+    p.add_argument("--tensorboard_dir", type=str, default=None,
+                   help="TensorBoard event-file dir (self-contained writer, "
+                        "no TF dependency; the reference's utils/config.py:8 "
+                        "knob made functional)")
     p.add_argument("--eval_every", type=int, default=d.eval_every,
                    help="epochs between evaluations; 0 disables")
     p.add_argument("--save_every", type=int, default=d.save_every)

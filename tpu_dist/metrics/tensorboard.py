@@ -1,0 +1,143 @@
+"""Minimal TensorBoard event-file writer — no TensorFlow dependency.
+
+The reference RESERVES TensorBoard support but never builds it: the dead
+config knob ``tensorboard_dir='runs'`` (``utils/config.py:8``) and the
+``.gitignore`` slot for ``/runs`` (``.gitignore:5``) are the whole
+feature. This module makes it real, self-contained: it hand-encodes the
+two protobuf messages TensorBoard's scalar dashboard needs (``Event`` and
+``Summary.Value.simple_value``) and frames them in the TFRecord format
+(length + masked-CRC32C), producing ``events.out.tfevents.*`` files any
+stock TensorBoard install reads. Verified against TensorBoard's own
+``event_accumulator`` reader in ``tests/test_tensorboard.py``.
+
+Host-side, rank-0-only (single-writer discipline like the checkpoint
+layer); pure stdlib so the TPU image needs no extra packages.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Optional
+
+# -- CRC32C (Castagnoli, reflected poly 0x82F63B78) — software table ---------
+
+_CRC_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ 0x82F63B78 if _c & 1 else _c >> 1
+    _CRC_TABLE.append(_c)
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# -- protobuf wire encoding (just the fields the scalar dashboard reads) -----
+
+
+def _varint(n: int) -> bytes:
+    n &= (1 << 64) - 1  # two's-complement int64, protobuf-style
+    out = bytearray()
+    while True:
+        bits = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def _field_double(num: int, v: float) -> bytes:
+    return bytes([(num << 3) | 1]) + struct.pack("<d", v)
+
+
+def _field_float(num: int, v: float) -> bytes:
+    return bytes([(num << 3) | 5]) + struct.pack("<f", v)
+
+
+def _field_varint(num: int, v: int) -> bytes:
+    return bytes([(num << 3) | 0]) + _varint(v)
+
+
+def _field_bytes(num: int, payload: bytes) -> bytes:
+    return bytes([(num << 3) | 2]) + _varint(len(payload)) + payload
+
+
+def _scalar_event(tag: str, value: float, step: int, wall_time: float) -> bytes:
+    value_msg = _field_bytes(1, tag.encode()) + _field_float(2, float(value))
+    summary = _field_bytes(1, value_msg)          # Summary.value (repeated)
+    return (
+        _field_double(1, wall_time)               # Event.wall_time
+        + _field_varint(2, int(step))             # Event.step
+        + _field_bytes(5, summary)                # Event.summary
+    )
+
+
+def _version_event(wall_time: float) -> bytes:
+    return _field_double(1, wall_time) + _field_bytes(3, b"brain.Event:2")
+
+
+class SummaryWriter:
+    """Append-only scalar event writer for one run directory.
+
+    ``SummaryWriter(logdir).add_scalar("train/loss", 1.23, step)`` — same
+    call shape as torch.utils.tensorboard, covering the slice of it the
+    reference's (never-built) integration would have used."""
+
+    def __init__(self, logdir: str):
+        os.makedirs(logdir, exist_ok=True)
+        name = (
+            f"events.out.tfevents.{int(time.time())}."
+            f"{socket.gethostname()}.{os.getpid()}"
+        )
+        self.path = os.path.join(logdir, name)
+        self._f = open(self.path, "ab")
+        self._record(_version_event(time.time()))
+
+    def _record(self, payload: bytes) -> None:
+        header = struct.pack("<Q", len(payload))
+        self._f.write(
+            header
+            + struct.pack("<I", _masked_crc(header))
+            + payload
+            + struct.pack("<I", _masked_crc(payload))
+        )
+
+    def add_scalar(self, tag: str, value: float, step: int,
+                   wall_time: Optional[float] = None) -> None:
+        self._record(
+            _scalar_event(
+                tag, value, step,
+                time.time() if wall_time is None else wall_time,
+            )
+        )
+        # flush per scalar: records are ~50 bytes and writes are per-epoch,
+        # so buffering buys nothing — a LIVE TensorBoard must see the run
+        self._f.flush()
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+    def __enter__(self) -> "SummaryWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -482,7 +482,7 @@ class Trainer:
                 compute_dtype=compute_dtype, **stats,
             )
 
-        self._async_ckpt = ckpt_lib.AsyncCheckpointer() if cfg.async_ckpt else None
+        self._async_ckpt = None  # created lazily by _ckpt_io()
         self.start_epoch = 0
         if cfg.resume and cfg.ckpt_dir:
             found = ckpt_lib.latest_checkpoint(cfg.ckpt_dir)
@@ -504,10 +504,6 @@ class Trainer:
         if self._async_ckpt is None:
             self._async_ckpt = ckpt_lib.AsyncCheckpointer()
         return self._async_ckpt
-
-    def _ckpt_wait(self) -> None:
-        if self._async_ckpt is not None:
-            self._async_ckpt.wait()
 
     def _ckpt_close(self, suppress: bool = False) -> None:
         """Drain + release the async writer. ``suppress=True`` logs a
@@ -746,6 +742,11 @@ class Trainer:
         last = {}
         self._last_epoch = self.start_epoch
         self._in_epoch = False
+        self._tb = None
+        if cfg.tensorboard_dir and mesh_lib.is_primary():
+            from tpu_dist.metrics.tensorboard import SummaryWriter  # noqa: PLC0415
+
+            self._tb = SummaryWriter(cfg.tensorboard_dir)
         try:
             result = self._fit_loop(epochs, history, last)
             self._ckpt_close()  # success path: writer errors RAISE here
@@ -758,6 +759,8 @@ class Trainer:
             # writes, but log writer failures rather than mask the
             # propagating exception
             self._ckpt_close(suppress=True)
+            if self._tb is not None:
+                self._tb.close()
 
     def _emergency_save(self) -> None:
         """Ctrl-C snapshot discipline.
@@ -836,6 +839,11 @@ class Trainer:
                 last = self.train_epoch(epoch)
             self._in_epoch = False
             history.log("train_epoch", epoch=epoch, **last)
+            if self._tb is not None:
+                for k in ("loss", "acc1", "acc5", "images_per_sec"):
+                    if k in last:
+                        self._tb.add_scalar(f"train/{k}", last[k], epoch)
+                self._tb.add_scalar("train/lr", self.lr_schedule(epoch), epoch)
             if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
                 if self._fused_runner is not None:
                     sums = {
@@ -853,6 +861,10 @@ class Trainer:
                     )
                 last.update(val_top1=t1, val_top5=t5, val_loss=vloss)
                 history.log("eval", epoch=epoch, top1=t1, top5=t5, loss=vloss)
+                if self._tb is not None:
+                    self._tb.add_scalar("eval/top1", t1, epoch)
+                    self._tb.add_scalar("eval/top5", t5, epoch)
+                    self._tb.add_scalar("eval/loss", vloss, epoch)
                 if cfg.ckpt_dir and t1 > best_top1:
                     best_top1 = t1
                     self._ckpt_io().save_best(
