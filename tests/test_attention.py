@@ -81,3 +81,80 @@ def test_ring_attention_grads_flow():
     g_ring = jax.grad(loss_sharded)(q, k, v)
     g_full = jax.grad(loss_full)(q, k, v)
     np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_full), rtol=1e-3, atol=1e-4)
+
+
+def test_ulysses_equals_full_4way():
+    mesh = mesh_lib.device_mesh([4], ["seq"], jax.devices()[:4])
+    q, k, v = _qkv(s=32, h=4, seed=5)
+
+    uly = jax.jit(
+        shard_map(
+            lambda q, k, v: A.ulysses_attention(q, k, v, "seq"),
+            mesh=mesh,
+            in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq"),
+            check_vma=False,
+        )
+    )
+    out = np.asarray(uly(q, k, v))
+    ref = np.asarray(A.full_attention(q, k, v))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_ulysses_causal_and_grads_match_full():
+    mesh = mesh_lib.device_mesh([4], ["seq"], jax.devices()[:4])
+    q, k, v = _qkv(s=16, h=4, seed=6)
+
+    def loss_sharded(q, k, v):
+        def f(q, k, v):
+            o = A.ulysses_attention(q, k, v, "seq", causal=True)
+            return jax.lax.psum(jnp.sum(o ** 2), "seq")
+
+        return shard_map(
+            f, mesh=mesh, in_specs=(P(None, "seq"),) * 3, out_specs=P(),
+            check_vma=False,
+        )(q, k, v)
+
+    def loss_full(q, k, v):
+        return jnp.sum(A.full_attention(q, k, v, causal=True) ** 2)
+
+    np.testing.assert_allclose(float(loss_sharded(q, k, v)), float(loss_full(q, k, v)), rtol=1e-5)
+    g_u = jax.grad(loss_sharded)(q, k, v)
+    g_f = jax.grad(loss_full)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g_u), np.asarray(g_f), rtol=1e-3, atol=1e-4)
+
+
+def test_ulysses_with_flash_impl():
+    """flash × SP: the ulysses local call runs the Pallas kernel."""
+    mesh = mesh_lib.device_mesh([4], ["seq"], jax.devices()[:4])
+    q, k, v = _qkv(s=32, h=4, seed=7)
+
+    uly_flash = jax.jit(
+        shard_map(
+            lambda q, k, v: A.ulysses_attention(q, k, v, "seq", impl="flash"),
+            mesh=mesh,
+            in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq"),
+            check_vma=False,
+        )
+    )
+    out = np.asarray(uly_flash(q, k, v))
+    ref = np.asarray(A.full_attention(q, k, v))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    import pytest
+
+    mesh = mesh_lib.device_mesh([4], ["seq"], jax.devices()[:4])
+    q, k, v = _qkv(s=32, h=3, seed=8)
+    with pytest.raises(ValueError, match="heads"):
+        jax.jit(
+            shard_map(
+                lambda q, k, v: A.ulysses_attention(q, k, v, "seq"),
+                mesh=mesh,
+                in_specs=(P(None, "seq"),) * 3,
+                out_specs=P(None, "seq"),
+                check_vma=False,
+            )
+        )(q, k, v)

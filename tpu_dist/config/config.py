@@ -59,6 +59,8 @@ class TrainConfig:
     # -- mesh shape ----------------------------------------------------------
     sp: int = 1                    # sequence-parallel ways (DPxSP mesh);
                                    # model must support seq_axis (ViT)
+    sp_mode: str = "ring"          # 'ring' (ppermute K/V rotation) or
+                                   # 'ulysses' (all_to_all tokens<->heads)
     tp: int = 1                    # tensor-parallel ways (DPxTP mesh);
                                    # model must support tp_axis (ViT)
     ep: int = 1                    # expert-parallel ways (DPxEP mesh);
@@ -171,6 +173,10 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--process_id", type=int, default=None)
     p.add_argument("--sp", type=int, default=d.sp,
                    help="sequence-parallel ways (ring attention; ViT)")
+    p.add_argument("--sp_mode", choices=("ring", "ulysses"), default=d.sp_mode,
+                   help="sequence-parallel strategy: 'ring' (ppermute K/V "
+                        "rotation) or 'ulysses' (all_to_all tokens<->heads; "
+                        "composes with --flash_attention)")
     p.add_argument("--tp", type=int, default=d.tp,
                    help="tensor-parallel ways (Megatron; ViT); composes with --sp")
     p.add_argument("--ep", type=int, default=d.ep,

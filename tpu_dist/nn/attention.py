@@ -14,6 +14,11 @@ TPU-style:
   O(S_local · S_block) and the sequence dimension scales with the number of
   devices on the axis. Combine with the ``data`` axis on a 2-D mesh for
   DP × SP.
+* :func:`ulysses_attention` — the all-to-all alternative: tokens↔heads
+  redistribution so each device runs full-sequence attention for H/n
+  heads (two collectives per call; composes with the Pallas flash
+  kernel). Pick by topology: ring = nearest-neighbor ICI traffic,
+  ulysses = fewer collectives and flash-compatible, needs heads % n == 0.
 
 Both operate on [B, S, H, D] (batch, sequence, heads, head_dim) and are
 shape-polymorphic under ``shard_map``.
@@ -130,27 +135,72 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False):
     return out.transpose(0, 2, 1, 3).astype(q.dtype)               # [B,Sq,H,D]
 
 
+def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                      impl: Optional[str] = None):
+    """All-to-all sequence parallelism (the DeepSpeed-Ulysses scheme —
+    the OTHER first-class long-context strategy next to the ring).
+
+    Inside ``shard_map`` with the sequence dim sharded over ``axis_name``:
+    one STACKED ``all_to_all`` (q/k/v together) redistributes tokens↔heads
+    so each device holds the FULL sequence for ``H/n`` of the heads,
+    ordinary single-device attention runs locally (attention never mixes
+    heads), and a second ``all_to_all`` restores the token sharding. Two
+    collectives per call versus the ring's ``n`` ppermutes; requires
+    ``heads % n == 0``.
+
+    Differentiable by plain autodiff (``all_to_all`` transposes to
+    ``all_to_all``) — no custom VJP needed. And because the local call IS
+    full-sequence attention, the Pallas flash kernel composes directly:
+    ``impl="flash"`` (or the process default) runs the tiled kernel on the
+    gathered sequence — flash × SP with no extra machinery.
+    """
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(
+            f"ulysses sequence parallelism needs heads ({h}) divisible by "
+            f"the axis size ({n}); use sp_mode='ring' otherwise"
+        )
+
+    # ONE stacked all_to_all for q/k/v (axes shifted by the leading stack
+    # dim), one for the output — two collectives total, as advertised
+    qkv = jnp.stack((q, k, v))  # [3, B, S/n, H, D]
+    qg, kg, vg = lax.all_to_all(
+        qkv, axis_name, split_axis=3, concat_axis=2, tiled=True
+    )                           # each [B, S, H/n, D]
+    o = full_attention(qg, kg, vg, causal=causal, impl=impl)
+    return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
 _warned_flash_ring = False
 
 
 def attention(q, k, v, *, causal: bool = False, seq_axis: Optional[str] = None,
-              impl: Optional[str] = None):
-    """Dispatch: ring attention when a sequence axis is given, else full
+              impl: Optional[str] = None, sp_mode: str = "ring"):
+    """Dispatch: sequence-parallel attention when a sequence axis is given
+    (``sp_mode``: "ring" rotation or "ulysses" all-to-all), else full
     (``impl``/module default selecting XLA vs Pallas flash).
 
-    Under a ``seq_axis`` the Pallas kernel does not apply (the ring is its
-    own blockwise online softmax — it never materializes a global [S, S];
-    each rotation computes one [S/n, S/n] local tile): a flash request is
-    acknowledged with a one-time warning rather than silently honored."""
+    Under the RING the Pallas kernel does not apply (the ring is its own
+    blockwise online softmax — it never materializes a global [S, S]; each
+    rotation computes one [S/n, S/n] local tile): a flash request is
+    acknowledged with a one-time warning rather than silently honored.
+    Under ULYSSES the flash impl applies directly (the local computation is
+    full-sequence attention)."""
     if seq_axis is not None:
+        if sp_mode == "ulysses":
+            return ulysses_attention(q, k, v, seq_axis, causal=causal, impl=impl)
+        if sp_mode != "ring":
+            raise ValueError(f"sp_mode must be 'ring' or 'ulysses', got {sp_mode!r}")
         if _resolve_impl(impl) == "flash":
             global _warned_flash_ring
             if not _warned_flash_ring:
                 _warned_flash_ring = True
                 print(
                     "tpu_dist: NOTE — flash attention impl does not apply under "
-                    "sequence parallelism; using ring attention (itself "
-                    "blockwise online-softmax, no global [S,S] materialized)",
+                    "ring sequence parallelism (itself blockwise online-softmax,"
+                    " no global [S,S] materialized); use --sp_mode ulysses to "
+                    "combine flash with SP",
                     flush=True,
                 )
         return ring_attention(q, k, v, seq_axis, causal=causal)

@@ -168,6 +168,7 @@ class ViTDef:
         train: bool = False,
         axis_name: Optional[str] = None,  # unused (no BN); kept for contract
         seq_axis: Optional[str] = None,
+        sp_mode: str = "ring",
         tp_axis: Optional[str] = None,
         tokens: Optional[jnp.ndarray] = None,
         pos_offset: int = 0,
@@ -227,7 +228,7 @@ class ViTDef:
             # layout [heads, 3, h_dim]: a contiguous column shard is whole heads
             qkv = qkv.reshape(b, s, h_loc, 3, h_dim)
             q, k, v = (qkv[:, :, :, i, :] for i in range(3))
-            o = attn_lib.attention(q, k, v, seq_axis=seq_axis)
+            o = attn_lib.attention(q, k, v, seq_axis=seq_axis, sp_mode=sp_mode)
             proj = reduce_from_tp(_dense_local(blk["proj"], o.reshape(b, s, h_loc * h_dim)))
             t = t + proj + blk["proj"]["b"].astype(t.dtype)
             y = copy_to_tp(_ln_apply(blk["ln2"], t))

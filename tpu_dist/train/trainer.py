@@ -157,6 +157,10 @@ class Trainer:
         # Trainer in the same process must not inherit a stale 'flash'
         set_default_attention_impl("flash" if cfg.flash_attention else "xla")
         self.model = build_model(cfg)
+        if cfg.sp_mode not in ("ring", "ulysses"):
+            raise ValueError(
+                f"sp_mode must be 'ring' or 'ulysses', got {cfg.sp_mode!r}"
+            )
         if cfg.sp > 1:
             import inspect  # noqa: PLC0415
 
@@ -165,6 +169,24 @@ class Trainer:
                     f"model {cfg.model!r} does not support sequence parallelism "
                     f"(no seq_axis in apply); use a ViT model or sp=1"
                 )
+            if cfg.sp_mode == "ulysses":
+                heads = getattr(self.model, "heads", None)
+                # under sp x tp the attention sees heads/tp LOCAL heads
+                # (column-sharded qkv) — validate the count it will see
+                local_heads = (
+                    heads // cfg.tp if heads is not None and cfg.tp > 1 else heads
+                )
+                if local_heads is not None and local_heads % cfg.sp:
+                    raise ValueError(
+                        f"sp_mode='ulysses' needs per-shard heads "
+                        f"({local_heads}{f' = {heads}/tp' if cfg.tp > 1 else ''}) "
+                        f"divisible by sp ({cfg.sp}); use sp_mode='ring'"
+                    )
+                if "sp_mode" not in inspect.signature(self.model.apply).parameters:
+                    raise ValueError(
+                        f"model {cfg.model!r} does not support sp_mode "
+                        f"(ulysses); use a ViT model or sp_mode='ring'"
+                    )
             if cfg.fused_epoch:
                 raise ValueError("sp > 1 is not supported with fused_epoch")
             n_tokens = getattr(self.model, "n_patches", None)
@@ -520,6 +542,11 @@ class Trainer:
             rank0_print(f"WARNING: background checkpoint write failed: {e}")
 
     def _build_train_step(self, cfg: TrainConfig, compute_dtype):
+        mk = {}
+        if cfg.pp > 1 and cfg.pp_microbatches:
+            mk["n_microbatches"] = cfg.pp_microbatches
+        if cfg.sp > 1 and cfg.sp_mode != "ring":
+            mk["sp_mode"] = cfg.sp_mode
         return make_train_step(
             self.model.apply, self.optimizer, self.mesh,
             grad_accum_steps=cfg.grad_accu_steps,
@@ -534,11 +561,7 @@ class Trainer:
             pp_axis=mesh_lib.PIPE_AXIS if cfg.pp > 1 else None,
             param_specs=self._param_specs,
             remat=cfg.remat,
-            model_kwargs=(
-                {"n_microbatches": cfg.pp_microbatches}
-                if cfg.pp > 1 and cfg.pp_microbatches
-                else None
-            ),
+            model_kwargs=mk or None,
         )
 
     def _ckpt_meta(self) -> dict:
