@@ -28,7 +28,8 @@ class TrainConfig:
     grad_accu_steps: int = 1       # distributed_gradient_accumulation.py:26
 
     # -- optimizer / schedule (hard-coded in the reference) -----------------
-    momentum: float = 0.9          # distributed.py:63
+    optimizer: str = "sgd"         # sgd (reference, distributed.py:63) | adamw
+    momentum: float = 0.9          # distributed.py:63 (sgd only)
     weight_decay: float = 1e-4     # distributed.py:63
     lr_schedule: str = "multistep" # multistep (reference) | cosine
     lr_milestones: Tuple[int, ...] = (60, 120, 160)  # distributed.py:64
@@ -132,6 +133,9 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=d.port)
     p.add_argument("--grad_accu_steps", type=int, default=d.grad_accu_steps,
                    help="gradient accumulation sub-steps (no_sync semantics)")
+    p.add_argument("--optimizer", choices=("sgd", "adamw"), default=d.optimizer,
+                   help="sgd (reference parity) or adamw (decoupled weight "
+                        "decay; the transformer default)")
     p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--weight_decay", type=float, default=d.weight_decay)
     p.add_argument("--lr_schedule", choices=("multistep", "cosine"), default=d.lr_schedule)

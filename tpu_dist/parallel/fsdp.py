@@ -86,14 +86,17 @@ def _shardings(mesh: Mesh, specs):
     )
 
 
-def state_shardings(mesh: Mesh, specs) -> TrainState:
-    """Shardings for a :class:`TrainState` under FSDP: params and momentum
-    follow ``specs``; BN stats and the step counter replicate (small)."""
+def state_shardings(mesh: Mesh, specs, opt_specs=None) -> TrainState:
+    """Shardings for a :class:`TrainState` under FSDP: params follow
+    ``specs``; optimizer state follows ``opt_specs`` when its tree differs
+    from the params tree (AdamW's {mu, nu, count} — build it with
+    ``fsdp_specs(optimizer.init(params), mesh)``), else ``specs``; BN stats
+    and the step counter replicate (small)."""
     rep = NamedSharding(mesh, P())
     return TrainState(
         params=_shardings(mesh, specs),
         bn_state=rep,
-        opt_state=_shardings(mesh, specs),
+        opt_state=_shardings(mesh, specs if opt_specs is None else opt_specs),
         step=rep,
     )
 
@@ -104,6 +107,7 @@ def make_fsdp_train_step(
     mesh: Mesh,
     specs,
     *,
+    opt_specs=None,
     grad_accum_steps: int = 1,
     compute_dtype=jnp.float32,
     axis: str = mesh_lib.DATA_AXIS,
@@ -120,7 +124,7 @@ def make_fsdp_train_step(
     compare it with the ``shard_map`` version to see what GSPMD buys.
     """
     K = int(grad_accum_steps)
-    st_sh = state_shardings(mesh, specs)
+    st_sh = state_shardings(mesh, specs, opt_specs)
     param_sh = st_sh.params
     batch_sh = NamedSharding(mesh, P(axis))
     rep = NamedSharding(mesh, P())
@@ -222,13 +226,14 @@ def make_fsdp_eval_step(
     mesh: Mesh,
     specs,
     *,
+    opt_specs=None,
     compute_dtype=jnp.float32,
     axis: str = mesh_lib.DATA_AXIS,
 ):
     """FSDP twin of :func:`tpu_dist.train.step.make_eval_step` — identical
     contract (masked GLOBAL sums of loss/top1/top5/count, so the streaming
     evaluator divides once at the end)."""
-    st_sh = state_shardings(mesh, specs)
+    st_sh = state_shardings(mesh, specs, opt_specs)
     batch_sh = NamedSharding(mesh, P(axis))
     rep = NamedSharding(mesh, P())
 

@@ -44,6 +44,12 @@ class SGD:
     def init(self, params):
         return jax.tree_util.tree_map(jnp.zeros_like, params)
 
+    def state_specs(self, param_specs):
+        """Optimizer-state partition specs given per-leaf param specs (used
+        by the TP/EP/PP and FSDP placements): SGD's momentum mirrors the
+        param tree exactly."""
+        return param_specs
+
     def update(self, grads, opt_state, params, lr):
         """Returns ``(new_params, new_opt_state)``. ``lr`` may be traced."""
         if self.fused:
@@ -104,3 +110,56 @@ def cosine_lr(base_lr: float, total_epochs: int, warmup_epochs: int = 0, min_lr:
         return float(min_lr + 0.5 * (base_lr - min_lr) * (1.0 + math.cos(math.pi * t)))
 
     return schedule
+
+
+class AdamW:
+    """Decoupled-weight-decay Adam (Loshchilov & Hutter) — the standard
+    transformer/ViT optimizer the reference never needed for its conv nets
+    (``distributed.py:63`` ships only SGD). Same pure-pytree contract as
+    :class:`SGD`: state is a plain dict pytree (first/second moments + step
+    count) that the checkpoint layer serializes and the FSDP engine shards
+    leaf-by-leaf. Verified step-for-step against ``optax.adamw``
+    (``tests/test_optim.py``).
+    """
+
+    def __init__(
+        self,
+        b1: float = 0.9,
+        b2: float = 0.999,
+        eps: float = 1e-8,
+        weight_decay: float = 0.01,
+    ):
+        self.b1 = b1
+        self.b2 = b2
+        self.eps = eps
+        self.weight_decay = weight_decay
+
+    def init(self, params):
+        zeros = lambda: jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=jnp.float32), params
+        )
+        return {"mu": zeros(), "nu": zeros(), "count": jnp.zeros((), jnp.int32)}
+
+    def state_specs(self, param_specs):
+        """mu/nu mirror the param tree's specs; the step count replicates."""
+        from jax.sharding import PartitionSpec as P  # noqa: PLC0415
+
+        return {"mu": param_specs, "nu": param_specs, "count": P()}
+
+    def update(self, grads, opt_state, params, lr):
+        """Returns ``(new_params, new_opt_state)``; ``lr`` may be traced."""
+        b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
+        tm = jax.tree_util.tree_map
+        count = opt_state["count"] + 1
+        cf = count.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** cf
+        bc2 = 1.0 - b2 ** cf
+
+        mu = tm(lambda m, g: b1 * m + (1.0 - b1) * g, opt_state["mu"], grads)
+        nu = tm(lambda v, g: b2 * v + (1.0 - b2) * jnp.square(g), opt_state["nu"], grads)
+        new_params = tm(
+            lambda p, m, v: p - lr * ((m / bc1) / (jnp.sqrt(v / bc2) + eps) + wd * p),
+            params, mu, nu,
+        )
+        return new_params, {"mu": mu, "nu": nu, "count": count}
+

@@ -328,10 +328,18 @@ def make_train_step(
         return unravel(flat_new), new_b_shard
 
     p_spec = param_specs if param_specs is not None else P()
+    if shard_weight_update:
+        opt_spec = P(axis)  # ZeRO-1 flat momentum vector (SGD only)
+    elif hasattr(optimizer, "state_specs"):
+        # optimizer state may not mirror the param tree (AdamW's
+        # {mu, nu, count}) — ask the optimizer for its layout
+        opt_spec = optimizer.state_specs(p_spec)
+    else:
+        opt_spec = p_spec
     state_spec = TrainState(
         params=p_spec,
         bn_state=P(),
-        opt_state=P(axis) if shard_weight_update else p_spec,
+        opt_state=opt_spec,
         step=P(),
     )
     batch_spec = P(batch_axes)
@@ -370,8 +378,13 @@ def make_eval_step(
     ep_axis: str | None = None,
     pp_axis: str | None = None,
     param_specs=None,
+    opt_specs=None,
 ):
     """Build ``eval_step(state, images, labels, mask) -> sums``.
+
+    ``opt_specs``: partition specs for the optimizer state when its TREE
+    differs from the param tree (AdamW under TP/EP/PP) — eval never reads
+    it, but the shard_map in_specs must still match its structure.
 
     Returns GLOBAL sums (loss·mask, top1, top5, count) so the host can
     divide once at the end — unlike the reference's ``validate()``, which
@@ -413,7 +426,12 @@ def make_eval_step(
         return jnp.sum(hits[:, :1]), jnp.sum(hits[:, :maxk])
 
     p_spec = param_specs if param_specs is not None else P()
-    state_spec = TrainState(params=p_spec, bn_state=P(), opt_state=p_spec, step=P())
+    state_spec = TrainState(
+        params=p_spec,
+        bn_state=P(),
+        opt_state=opt_specs if opt_specs is not None else p_spec,
+        step=P(),
+    )
     sharded = shard_map(
         eval_local,
         mesh=mesh,

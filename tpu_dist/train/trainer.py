@@ -425,17 +425,37 @@ class Trainer:
         )
 
         # -- model / optimizer state ----------------------------------------
-        self.optimizer = SGD(
-            momentum=cfg.momentum,
-            weight_decay=cfg.weight_decay,
-            fused=cfg.fused_optimizer,
-        )
+        if cfg.optimizer == "adamw":
+            if cfg.fused_optimizer:
+                raise ValueError(
+                    "fused_optimizer is the Pallas fused-SGD kernel; adamw "
+                    "uses the plain (XLA-fused) update"
+                )
+            if cfg.shard_weight_update:
+                raise ValueError(
+                    "zero1 weight-update sharding supports sgd only (its "
+                    "flat layout assumes one momentum buffer); use --fsdp "
+                    "to shard adamw state"
+                )
+            from tpu_dist.train.optim import AdamW  # noqa: PLC0415
+
+            self.optimizer = AdamW(weight_decay=cfg.weight_decay)
+        elif cfg.optimizer == "sgd":
+            self.optimizer = SGD(
+                momentum=cfg.momentum,
+                weight_decay=cfg.weight_decay,
+                fused=cfg.fused_optimizer,
+            )
+        else:
+            raise ValueError(f"unknown optimizer {cfg.optimizer!r} (sgd | adamw)")
         params, bn_state = self.model.init(jax.random.PRNGKey(seed))
         state = TrainState.create(params, bn_state, self.optimizer)
+        self._fsdp_opt_specs = None
         if cfg.fsdp:
             from tpu_dist.parallel.fsdp import fsdp_specs  # noqa: PLC0415
 
             self._fsdp_specs = fsdp_specs(params, self.mesh)
+            self._fsdp_opt_specs = fsdp_specs(state.opt_state, self.mesh)
         if cfg.shard_weight_update and cfg.fused_epoch:
             raise ValueError("shard_weight_update is not supported with fused_epoch yet")
         # place on the mesh (DDP's init-time param broadcast; sharded
@@ -455,6 +475,7 @@ class Trainer:
 
             self.train_step = make_fsdp_train_step(
                 self.model.apply, self.optimizer, self.mesh, self._fsdp_specs,
+                opt_specs=self._fsdp_opt_specs,
                 grad_accum_steps=cfg.grad_accu_steps,
                 compute_dtype=compute_dtype,
                 label_smoothing=cfg.label_smoothing,
@@ -463,6 +484,7 @@ class Trainer:
             )
             self.eval_step = make_fsdp_eval_step(
                 self.model.apply, self.mesh, self._fsdp_specs,
+                opt_specs=self._fsdp_opt_specs,
                 compute_dtype=compute_dtype,
             )
         else:
@@ -474,6 +496,11 @@ class Trainer:
                 ep_axis=mesh_lib.EXPERT_AXIS if cfg.ep > 1 else None,
                 pp_axis=mesh_lib.PIPE_AXIS if cfg.pp > 1 else None,
                 param_specs=self._param_specs,
+                opt_specs=(
+                    self.optimizer.state_specs(self._param_specs)
+                    if self._param_specs is not None
+                    else None
+                ),
             )
 
         self._fused_runner = None
@@ -643,20 +670,23 @@ class Trainer:
                 ),
                 bn_state=mesh_lib.place_host_tree(self.mesh, state.bn_state),
                 opt_state=mesh_lib.place_host_tree(
-                    self.mesh, state.opt_state, self._fsdp_specs
+                    self.mesh, state.opt_state, self._fsdp_opt_specs
                 ),
                 step=mesh_lib.place_host_tree(self.mesh, state.step),
             )
         if self._param_specs is not None:  # TP/EP/PP per-leaf shardings
             # place_host_tree also covers the multi-host case, where
-            # device_put cannot target non-addressable model shards
+            # device_put cannot target non-addressable model shards.
+            # Optimizer state may not mirror the param tree (AdamW) —
+            # its layout comes from the optimizer.
             return TrainState(
                 params=mesh_lib.place_host_tree(
                     self.mesh, state.params, self._param_specs
                 ),
                 bn_state=mesh_lib.place_host_tree(self.mesh, state.bn_state),
                 opt_state=mesh_lib.place_host_tree(
-                    self.mesh, state.opt_state, self._param_specs
+                    self.mesh, state.opt_state,
+                    self.optimizer.state_specs(self._param_specs),
                 ),
                 step=mesh_lib.place_host_tree(self.mesh, state.step),
             )
