@@ -66,6 +66,7 @@ class TrainConfig:
                                    # model must support tp_axis (ViT)
     ep: int = 1                    # expert-parallel ways (DPxEP mesh);
                                    # model must support ep_axis (ViT-MoE)
+    moe_top_k: int = 1             # experts per token (1=Switch, 2=GShard)
     pp: int = 1                    # pipeline-parallel stages (DPxPP mesh);
                                    # model must support pp_axis (ViT-PP)
     pp_microbatches: int = 0       # 0 = one microbatch per stage
@@ -185,6 +186,9 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="tensor-parallel ways (Megatron; ViT); composes with --sp")
     p.add_argument("--ep", type=int, default=d.ep,
                    help="expert-parallel ways (MoE ViT)")
+    p.add_argument("--moe_top_k", type=int, default=d.moe_top_k,
+                   help="experts per token for MoE models (1 = Switch, "
+                        "2 = GShard-style renormalized gates)")
     p.add_argument("--pp", type=int, default=d.pp,
                    help="pipeline stages (staged ViT)")
     p.add_argument("--pp_microbatches", type=int, default=d.pp_microbatches,

@@ -47,6 +47,7 @@ class ViTMoEDef:
     heads: int = 4
     n_experts: int = 8
     capacity_factor: float = 2.0
+    top_k: int = 1  # experts per token (1 = Switch, 2 = GShard-style)
     num_classes: int = 10
 
     @property
@@ -55,7 +56,7 @@ class ViTMoEDef:
 
     @property
     def moe(self) -> MoE:
-        return MoE(self.n_experts, self.capacity_factor)
+        return MoE(self.n_experts, self.capacity_factor, self.top_k)
 
     def init(self, key, dtype=jnp.float32):
         keys = iter(jax.random.split(key, 8 + 4 * self.depth))

@@ -1,19 +1,23 @@
-"""Expert parallelism: top-1 (Switch-style) Mixture-of-Experts over a mesh
-axis, with capacity-based dispatch/combine through ``lax.all_to_all``.
+"""Expert parallelism: top-k Mixture-of-Experts over a mesh axis, with
+capacity-based dispatch/combine through ``lax.all_to_all``.
 
 Beyond the reference's scope (SURVEY §2.3: no EP anywhere), built so the
 ``expert`` mesh axis is exercised for real:
 
 * every device holds ``E/n`` experts' weights (expert-sharded params),
-* tokens are routed top-1 with a capacity limit ``C`` per expert,
+* tokens are routed top-k (k=1 is Switch, k=2 is GShard-style) with a
+  capacity limit ``C`` per expert; first choices of every token claim
+  slots before any second choice does (choice-major priority, the GShard
+  rule),
 * dispatch: one-hot einsum packs tokens into ``[E, C, d]`` slots, then ONE
   ``all_to_all`` over the axis moves each expert's slots to its owner,
 * experts run their FFN on their ``[n_local_tokens... , C, d]`` slab,
-* combine: the reverse ``all_to_all`` + weighted einsum restores token
-  order, scaled by the router gate.
+* combine: the reverse ``all_to_all`` + gate-weighted einsum restores
+  token order (for k>1 the k gates are renormalized to sum to one).
 
 Tokens that overflow an expert's capacity are dropped (standard Switch
-behavior) — their output is 0 and the residual connection carries them.
+behavior) — that choice contributes 0 and the residual connection carries
+the token.
 """
 
 from __future__ import annotations
@@ -27,15 +31,19 @@ from jax import lax
 
 @dataclass(frozen=True)
 class MoE:
-    """Top-1 MoE FFN. ``n_experts`` must be a multiple of the axis size.
+    """Top-k MoE FFN. ``n_experts`` must be a multiple of the axis size.
 
     ``init(key, d_model, d_ff)`` → params with leading expert dim E.
     Shard params over the axis with ``P('expert')`` on that dim (or slice
     manually per device inside shard_map via ``params_local``).
+
+    ``top_k=1`` gates by the raw softmax probability (Switch); ``top_k>1``
+    renormalizes the chosen probabilities to sum to one (GShard).
     """
 
     n_experts: int
     capacity_factor: float = 1.25
+    top_k: int = 1
 
     def init(self, key, d_model: int, d_ff: int):
         k1, k2, k3 = jax.random.split(key, 3)
@@ -53,13 +61,12 @@ class MoE:
     def apply_dense(self, params, x):
         """[T, d] → [T, d]; ground truth for the EP path."""
         T, d = x.shape
-        E = self.n_experts
         C = self._capacity(T)
-        gates, idx, disp = self._route(params, x, C)
-        slots = jnp.einsum("tec,td->ecd", disp, x)            # [E, C, d]
+        pack, combine = self._route(params, x, C)
+        slots = jnp.einsum("tec,td->ecd", pack, x)            # [E, C, d]
         h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", slots, params["w_in"]))
         out = jnp.einsum("ecf,efd->ecd", h, params["w_out"])  # [E, C, d]
-        return jnp.einsum("tec,ecd->td", disp, out) * gates[:, None]
+        return jnp.einsum("tec,ecd->td", combine, out)
 
     # -- expert-parallel (inside shard_map over `axis`) ---------------------
 
@@ -79,8 +86,8 @@ class MoE:
         e_loc = E // n
         C = self._capacity(T_loc)
 
-        gates, idx, disp = self._route({"router": params_repl_router}, x, C)
-        slots = jnp.einsum("tec,td->ecd", disp, x)             # [E, C, d]
+        pack, combine = self._route({"router": params_repl_router}, x, C)
+        slots = jnp.einsum("tec,td->ecd", pack, x)             # [E, C, d]
         # group by owner device: [n, e_loc, C, d] → all_to_all over axis
         slots = slots.reshape(n, e_loc, C, d)
         recv = lax.all_to_all(slots, axis, split_axis=0, concat_axis=0, tiled=False)
@@ -90,25 +97,41 @@ class MoE:
         # send results back to the token owners
         back = lax.all_to_all(out, axis, split_axis=0, concat_axis=0, tiled=False)
         back = back.reshape(E, C, d)
-        return jnp.einsum("tec,ecd->td", disp, back) * gates[:, None]
+        return jnp.einsum("tec,ecd->td", combine, back)
 
     # -- shared routing ------------------------------------------------------
 
     def _capacity(self, T: int) -> int:
-        return max(1, int(self.capacity_factor * T / self.n_experts))
+        return max(1, int(self.capacity_factor * self.top_k * T / self.n_experts))
 
     def _route(self, params, x, C: int):
-        """Top-1 routing with capacity: returns (gates [T], idx [T],
-        dispatch one-hot [T, E, C])."""
+        """Top-k routing with capacity. Returns two [T, E, C] dispatch
+        tensors: ``pack`` (binary — which slot each token occupies, up to k
+        of them) and ``combine`` (gate-weighted — how expert outputs sum
+        back per token)."""
+        T = x.shape[0]
+        E, k = self.n_experts, self.top_k
         logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
         probs = jax.nn.softmax(logits, axis=-1)
-        idx = jnp.argmax(probs, axis=-1)                      # [T]
-        gates = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
-        onehot = jax.nn.one_hot(idx, self.n_experts, dtype=jnp.int32)  # [T, E]
-        pos = jnp.cumsum(onehot, axis=0) * onehot - 1         # slot per token
+        topk_probs, topk_idx = lax.top_k(probs, k)            # [T, k]
+        if k == 1:
+            gates = topk_probs                                # Switch: raw prob
+        else:
+            gates = topk_probs / jnp.maximum(
+                topk_probs.sum(-1, keepdims=True), 1e-9
+            )                                                 # GShard: renorm
+
+        # CHOICE-MAJOR slot assignment: every token's 1st choice outranks
+        # any token's 2nd choice for the capacity budget
+        oh = jax.nn.one_hot(topk_idx, E, dtype=jnp.int32)     # [T, k, E]
+        oh_cm = oh.transpose(1, 0, 2).reshape(k * T, E)       # [k*T, E]
+        pos = jnp.cumsum(oh_cm, axis=0) * oh_cm - 1           # slot per entry
         keep = (pos < C) & (pos >= 0)
-        # slot of the routed expert (-1 when dropped); one_hot(-1) is all-zero
-        slot = jnp.where(keep, pos, -1).max(-1)
-        pos_oh = jax.nn.one_hot(slot, C, dtype=x.dtype)       # [T, C]
-        disp = onehot.astype(x.dtype)[:, :, None] * pos_oh[:, None, :]
-        return gates.astype(x.dtype), idx, disp
+        slot = jnp.where(keep, pos, -1).max(-1)               # [k*T]; -1 = drop
+        pos_oh = jax.nn.one_hot(slot, C, dtype=x.dtype)       # [k*T, C]
+        disp_cm = oh_cm.astype(x.dtype)[:, :, None] * pos_oh[:, None, :]
+        disp_k = disp_cm.reshape(k, T, E, C)                  # per-choice
+
+        pack = disp_k.sum(0)                                  # binary [T, E, C]
+        combine = jnp.einsum("ktec,tk->tec", disp_k, gates.astype(x.dtype))
+        return pack, combine

@@ -250,6 +250,22 @@ class Trainer:
                     "(grad_clip_norm composes — shard-aware norm in step.py)"
                 )
             self._param_specs = self.model.tp_param_specs(mesh_lib.MODEL_AXIS)
+        if cfg.moe_top_k < 1:
+            raise ValueError(f"moe_top_k must be >= 1, got {cfg.moe_top_k}")
+        if cfg.moe_top_k > 1:
+            import dataclasses as _dc  # noqa: PLC0415
+
+            if not (_dc.is_dataclass(self.model) and hasattr(self.model, "top_k")):
+                raise ValueError(
+                    f"model {cfg.model!r} has no MoE router (no top_k field) "
+                    f"— --moe_top_k applies to vit_moe_* models"
+                )
+            if cfg.moe_top_k > self.model.n_experts:
+                raise ValueError(
+                    f"moe_top_k={cfg.moe_top_k} exceeds the model's "
+                    f"{self.model.n_experts} experts"
+                )
+            self.model = _dc.replace(self.model, top_k=cfg.moe_top_k)
         if cfg.ep > 1:
             import inspect  # noqa: PLC0415
 
