@@ -40,9 +40,12 @@ def test_dp_ep_training_matches_per_shard_dense():
         opt_state=place(st.opt_state),
         step=jax.device_put(st.step, mesh_lib.replicated(mesh2d)),
     )
+    # aux coef 0: this test pins the dispatch/gradient math against a
+    # train=False host reference; the aux objective has its own test
+    # (test_parallel.py::test_moe_aux_loss_threads_through_train_step)
     step_ep = make_train_step(
         model.apply, opt, mesh2d, sync_bn=False, donate=False,
-        ep_axis="expert", param_specs=specs,
+        ep_axis="expert", param_specs=specs, moe_aux_coef=0.0,
     )
 
     # host-side reference: same per-shard routing, gradient = mean of

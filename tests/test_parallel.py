@@ -199,7 +199,7 @@ def test_moe_top2_first_choices_outrank_second_choices():
     x = jnp.asarray([[1.0, 0.0], [0.0, 1.0]], jnp.float32)
     C = moe._capacity(2)
     assert C == 1
-    pack, _ = moe._route(params, x, C)
+    pack, _, _ = moe._route(params, x, C)
     pack = np.asarray(pack)  # [T, E, C]
     assert pack[0, 0].sum() == 1.0, "token 0's FIRST choice (E0) keeps its slot"
     assert pack[1, 1].sum() == 1.0, "token 1's FIRST choice (E1) wins the slot"
@@ -220,3 +220,59 @@ def test_trainer_moe_top2_e2e():
     assert t.model.top_k == 2
     out = t.fit()
     assert np.isfinite(out["loss"])
+
+
+def test_moe_aux_loss_values():
+    """Load-balancing loss: ~1 for a uniform router, ~E when collapsed."""
+    moe = MoE(n_experts=4, capacity_factor=4.0, top_k=1)
+    d = 8
+    T = 64
+    x = jnp.asarray(np.random.default_rng(10).normal(size=(T, d)), jnp.float32)
+
+    # near-uniform router: tiny weights -> probs ~ 1/E, f_e ~ 1/E
+    params_uniform = {"router": jnp.zeros((d, 4), jnp.float32) + 1e-6 * jnp.asarray(
+        np.random.default_rng(11).normal(size=(d, 4)), jnp.float32
+    )}
+    _, _, aux_u = moe._route(params_uniform, x, moe._capacity(T))
+    assert abs(float(aux_u) - 1.0) < 0.15
+
+    # collapsed router: everything to expert 0 -> f_0=1, P_0~1 -> aux ~ E
+    params_collapsed = {"router": jnp.zeros((d, 4), jnp.float32).at[:, 0].set(50.0)}
+    xpos = jnp.abs(x)  # keep logits for expert 0 dominant
+    _, _, aux_c = moe._route(params_collapsed, xpos, moe._capacity(T))
+    assert float(aux_c) > 2.5
+
+
+def test_moe_aux_loss_threads_through_train_step():
+    """vit_moe returns the aux loss in its state; the train step must pop
+    it (stable TrainState structure) and fold coef*aux into the loss."""
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.nn.vit_moe import vit_moe_tiny
+    from tpu_dist.train.optim import SGD
+    from tpu_dist.train.state import TrainState
+    from tpu_dist.train.step import make_train_step
+
+    mesh = mesh_lib.data_parallel_mesh()
+    model = vit_moe_tiny(num_classes=5)
+    opt = SGD()
+    params, st = model.init(jax.random.PRNGKey(12))
+    state0 = jax.device_put(
+        TrainState.create(params, st, opt), mesh_lib.replicated(mesh)
+    )
+
+    rng = np.random.default_rng(13)
+    x = mesh_lib.shard_batch(mesh, rng.normal(size=(16, 32, 32, 3)).astype(np.float32))
+    y = mesh_lib.shard_batch(mesh, rng.integers(0, 5, 16).astype(np.int32))
+
+    losses = {}
+    for coef in (0.0, 10.0):
+        step = make_train_step(
+            model.apply, opt, mesh, sync_bn=False, donate=False, moe_aux_coef=coef
+        )
+        s1, m1 = step(state0, x, y, 0.0)
+        # structure unchanged -> a second step reuses the SAME compiled fn
+        s2, m2 = step(s1, x, y, 0.0)
+        assert jax.tree_util.tree_structure(s1) == jax.tree_util.tree_structure(state0)
+        losses[coef] = float(m1["loss"])
+    # aux > 0 always, so the coef=10 objective is strictly larger
+    assert losses[10.0] > losses[0.0] + 1e-3

@@ -67,6 +67,7 @@ class TrainConfig:
     ep: int = 1                    # expert-parallel ways (DPxEP mesh);
                                    # model must support ep_axis (ViT-MoE)
     moe_top_k: int = 1             # experts per token (1=Switch, 2=GShard)
+    moe_aux_coef: float = 0.01     # router load-balancing loss coefficient
     pp: int = 1                    # pipeline-parallel stages (DPxPP mesh);
                                    # model must support pp_axis (ViT-PP)
     pp_microbatches: int = 0       # 0 = one microbatch per stage
@@ -189,6 +190,9 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--moe_top_k", type=int, default=d.moe_top_k,
                    help="experts per token for MoE models (1 = Switch, "
                         "2 = GShard-style renormalized gates)")
+    p.add_argument("--moe_aux_coef", type=float, default=d.moe_aux_coef,
+                   help="coefficient of the MoE router load-balancing loss "
+                        "(Switch Transformer aux loss); 0 disables")
     p.add_argument("--pp", type=int, default=d.pp,
                    help="pipeline stages (staged ViT)")
     p.add_argument("--pp_microbatches", type=int, default=d.pp_microbatches,

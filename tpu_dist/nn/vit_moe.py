@@ -118,7 +118,12 @@ class ViTMoEDef:
         expert axes (the expert axis doubles as a data axis everywhere
         outside the MoE), expert weights arrive sharded
         (:meth:`ep_param_specs`), and each block's MoE exchanges tokens with
-        its expert owners via ``all_to_all``."""
+        its expert owners via ``all_to_all``.
+
+        Training returns the depth-averaged router load-balancing loss in
+        the state dict (``{"moe_aux_loss": scalar}``) — the train step adds
+        ``moe_aux_coef`` times it to the objective and drops the key before
+        the state is stored."""
         del axis_name
         tokens = self.patchify(x)
         t = _dense(params["patch"], tokens)
@@ -127,6 +132,7 @@ class ViTMoEDef:
 
         h_dim = self.dim // self.heads
         b = t.shape[0]
+        aux_total = jnp.zeros((), jnp.float32)
         for blk in params["blocks"]:
             y = _ln_apply(blk["ln1"], t)
             qkv = _dense(blk["qkv"], y)
@@ -139,19 +145,24 @@ class ViTMoEDef:
             y = _ln_apply(blk["ln2"], t)
             flat = y.reshape(b * s, self.dim)
             if ep_axis is None:
-                out = self.moe.apply_dense(blk["moe"], flat)
+                out, aux = self.moe.apply_dense(blk["moe"], flat, with_aux=True)
             else:
-                out = self.moe.apply_ep(
+                out, aux = self.moe.apply_ep(
                     blk["moe"]["router"],
                     blk["moe"]["w_in"],
                     blk["moe"]["w_out"],
                     flat,
                     ep_axis,
+                    with_aux=True,
                 )
+            aux_total = aux_total + aux.astype(jnp.float32)
             t = t + out.reshape(b, s, self.dim)
 
         t = _ln_apply(params["ln_f"], t)
-        return _dense(params["head"], t.mean(axis=1)), state
+        logits = _dense(params["head"], t.mean(axis=1))
+        if train:
+            return logits, {"moe_aux_loss": aux_total / self.depth}
+        return logits, state
 
 
 def vit_moe_tiny(num_classes: int = 10, image_size: int = 32) -> ViTMoEDef:

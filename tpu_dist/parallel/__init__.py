@@ -6,3 +6,8 @@ from tpu_dist.parallel.tensor import (  # noqa: F401
 )
 from tpu_dist.parallel.expert import MoE  # noqa: F401
 from tpu_dist.parallel.pipeline import pipeline_apply  # noqa: F401
+from tpu_dist.parallel.fsdp import (  # noqa: F401
+    fsdp_specs,
+    make_fsdp_eval_step,
+    make_fsdp_train_step,
+)

@@ -58,19 +58,22 @@ class MoE:
 
     # -- dense reference (single device, no sharding) -----------------------
 
-    def apply_dense(self, params, x):
-        """[T, d] → [T, d]; ground truth for the EP path."""
+    def apply_dense(self, params, x, *, with_aux: bool = False):
+        """[T, d] → [T, d]; ground truth for the EP path. ``with_aux=True``
+        also returns the load-balancing loss (see :meth:`aux_loss`)."""
         T, d = x.shape
         C = self._capacity(T)
-        pack, combine = self._route(params, x, C)
+        pack, combine, aux = self._route(params, x, C)
         slots = jnp.einsum("tec,td->ecd", pack, x)            # [E, C, d]
         h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", slots, params["w_in"]))
         out = jnp.einsum("ecf,efd->ecd", h, params["w_out"])  # [E, C, d]
-        return jnp.einsum("tec,ecd->td", combine, out)
+        y = jnp.einsum("tec,ecd->td", combine, out)
+        return (y, aux) if with_aux else y
 
     # -- expert-parallel (inside shard_map over `axis`) ---------------------
 
-    def apply_ep(self, params_repl_router, w_in_local, w_out_local, x, axis: str):
+    def apply_ep(self, params_repl_router, w_in_local, w_out_local, x, axis: str,
+                 *, with_aux: bool = False):
         """Expert-parallel forward for THIS device's token shard ``x``
         [T_loc, d]. ``w_in_local``/``w_out_local``: [E/n, d, f] local expert
         slabs; router weights replicated.
@@ -86,7 +89,7 @@ class MoE:
         e_loc = E // n
         C = self._capacity(T_loc)
 
-        pack, combine = self._route({"router": params_repl_router}, x, C)
+        pack, combine, aux = self._route({"router": params_repl_router}, x, C)
         slots = jnp.einsum("tec,td->ecd", pack, x)             # [E, C, d]
         # group by owner device: [n, e_loc, C, d] → all_to_all over axis
         slots = slots.reshape(n, e_loc, C, d)
@@ -97,7 +100,8 @@ class MoE:
         # send results back to the token owners
         back = lax.all_to_all(out, axis, split_axis=0, concat_axis=0, tiled=False)
         back = back.reshape(E, C, d)
-        return jnp.einsum("tec,ecd->td", combine, back)
+        y = jnp.einsum("tec,ecd->td", combine, back)
+        return (y, aux) if with_aux else y
 
     # -- shared routing ------------------------------------------------------
 
@@ -106,9 +110,14 @@ class MoE:
 
     def _route(self, params, x, C: int):
         """Top-k routing with capacity. Returns two [T, E, C] dispatch
-        tensors: ``pack`` (binary — which slot each token occupies, up to k
-        of them) and ``combine`` (gate-weighted — how expert outputs sum
-        back per token)."""
+        tensors — ``pack`` (binary: which slot each token occupies, up to k
+        of them) and ``combine`` (gate-weighted: how expert outputs sum
+        back per token) — plus the scalar load-balancing auxiliary loss
+        (Switch Transformer §2.2): ``E · Σ_e f_e · P_e`` with ``f_e`` the
+        fraction of tokens whose TOP choice is expert e (non-differentiable
+        count) and ``P_e`` the mean router probability for e
+        (differentiable). Minimized (→ 1) by a uniform router; the
+        coefficient is the caller's (``--moe_aux_coef``)."""
         T = x.shape[0]
         E, k = self.n_experts, self.top_k
         logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
@@ -134,4 +143,8 @@ class MoE:
 
         pack = disp_k.sum(0)                                  # binary [T, E, C]
         combine = jnp.einsum("ktec,tk->tec", disp_k, gates.astype(x.dtype))
-        return pack, combine
+
+        f_e = oh[:, 0, :].astype(jnp.float32).mean(0)         # top-choice freq
+        P_e = probs.mean(0)                                   # mean router prob
+        aux = E * jnp.sum(f_e * P_e)
+        return pack, combine, aux.astype(x.dtype)

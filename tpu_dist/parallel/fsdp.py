@@ -114,6 +114,7 @@ def make_fsdp_train_step(
     donate: bool = True,
     label_smoothing: float = 0.0,
     grad_clip_norm: float = 0.0,
+    moe_aux_coef: float = 0.01,
     remat: bool = False,
 ):
     """Build ``step(state, images, labels, lr) -> (state, metrics)``, the
@@ -135,7 +136,12 @@ def make_fsdp_train_step(
         # axis_name=None: the mean/var in BN run over the global batch —
         # under GSPMD that IS cross-replica SyncBN (module docstring).
         logits, new_bn = model_apply(p, bn_state, x, train=True, axis_name=None)
+        from tpu_dist.train.step import extract_aux_loss  # noqa: PLC0415
+
+        new_bn, aux = extract_aux_loss(new_bn)
         loss = F.cross_entropy(logits, labels, label_smoothing=label_smoothing)
+        if aux is not None:
+            loss = loss + moe_aux_coef * aux.astype(loss.dtype)
         return loss, (new_bn, logits)
 
     if remat:

@@ -80,6 +80,7 @@ def make_fused_epoch(
     axis: str = mesh_lib.DATA_AXIS,
     mean: np.ndarray = CIFAR100_MEAN,
     std: np.ndarray = CIFAR100_STD,
+    moe_aux_coef: float = 0.01,
 ):
     """Build ``epoch(state, images_u8, labels, lr, epoch_idx) ->
     (state, metrics)`` running every step of the epoch on device.
@@ -110,10 +111,16 @@ def make_fused_epoch(
         base = jax.random.fold_in(jax.random.fold_in(jax.random.PRNGKey(0), epoch_idx), dev)
         perm = jax.random.permutation(base, n_loc)
 
+        from tpu_dist.train.step import extract_aux_loss  # noqa: PLC0415
+
         def loss_fn(params, bn_state, x, y):
             p = jax.tree_util.tree_map(lambda t: t.astype(compute_dtype), params)
             logits, new_bn = model_apply(p, bn_state, x, train=True, axis_name=bn_axis)
-            return F.cross_entropy(logits, y), (new_bn, logits)
+            new_bn, aux = extract_aux_loss(new_bn)
+            loss = F.cross_entropy(logits, y)
+            if aux is not None:
+                loss = loss + moe_aux_coef * aux.astype(loss.dtype)
+            return loss, (new_bn, logits)
 
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 

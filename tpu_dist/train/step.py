@@ -43,6 +43,20 @@ from tpu_dist.nn import functional as F
 from tpu_dist.train.state import TrainState
 
 
+def extract_aux_loss(new_bn):
+    """Split a model's auxiliary training loss out of its returned state.
+
+    MoE models report the router load-balancing loss by returning
+    ``{"moe_aux_loss": scalar}`` in the state dict during training
+    (``vit_moe.py``); it must be POPPED before the state is stored so the
+    TrainState pytree structure stays identical step to step (and matches
+    the eval-time state). Returns ``(clean_state, aux_or_None)``."""
+    if isinstance(new_bn, dict) and "moe_aux_loss" in new_bn:
+        new_bn = dict(new_bn)
+        return new_bn, new_bn.pop("moe_aux_loss")
+    return new_bn, None
+
+
 def make_train_step(
     model_apply: Callable,
     optimizer,
@@ -56,6 +70,7 @@ def make_train_step(
     shard_weight_update: bool = False,
     label_smoothing: float = 0.0,
     grad_clip_norm: float = 0.0,
+    moe_aux_coef: float = 0.01,
     seq_axis: str | None = None,
     tp_axis: str | None = None,
     ep_axis: str | None = None,
@@ -154,7 +169,10 @@ def make_train_step(
         if model_kwargs:
             kw.update(model_kwargs)
         logits, new_bn = model_apply(p, bn_state, x, train=True, axis_name=bn_axis, **kw)
+        new_bn, aux = extract_aux_loss(new_bn)
         loss = F.cross_entropy(logits, labels, label_smoothing=label_smoothing)
+        if aux is not None:
+            loss = loss + moe_aux_coef * aux.astype(loss.dtype)
         return loss, (new_bn, logits)
 
     def clip_grads(grads):

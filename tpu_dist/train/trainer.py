@@ -496,6 +496,7 @@ class Trainer:
                 compute_dtype=compute_dtype,
                 label_smoothing=cfg.label_smoothing,
                 grad_clip_norm=cfg.grad_clip_norm,
+                moe_aux_coef=cfg.moe_aux_coef,
                 remat=cfg.remat,
             )
             self.eval_step = make_fsdp_eval_step(
@@ -531,7 +532,8 @@ class Trainer:
             self._fused_runner = make_fused_epoch(
                 self.model.apply, self.optimizer, self.mesh,
                 batch_per_device=cfg.batch_size // self.n_devices,
-                sync_bn=cfg.sync_bn, compute_dtype=compute_dtype, **stats,
+                sync_bn=cfg.sync_bn, compute_dtype=compute_dtype,
+                moe_aux_coef=cfg.moe_aux_coef, **stats,
             )
             # round the test set UP to a device multiple with label=-1
             # padding so fused eval counts every real example exactly once
@@ -598,6 +600,7 @@ class Trainer:
             shard_weight_update=cfg.shard_weight_update,
             label_smoothing=cfg.label_smoothing,
             grad_clip_norm=cfg.grad_clip_norm,
+            moe_aux_coef=cfg.moe_aux_coef,
             seq_axis=mesh_lib.SEQ_AXIS if cfg.sp > 1 else None,
             tp_axis=mesh_lib.MODEL_AXIS if cfg.tp > 1 else None,
             ep_axis=mesh_lib.EXPERT_AXIS if cfg.ep > 1 else None,
