@@ -340,6 +340,13 @@ def main() -> None:
             }
         )
 
+    # One-TPU-process rule: refuse (exit 4, clear holder message) rather
+    # than start a second PJRT client and wedge the tunnel. Must run before
+    # any backend init. No-op when the platform is forced to CPU.
+    from tpu_dist.comm import tpu_lock
+
+    tpu_lock.guard_or_exit("bench")
+
     # persistent XLA compile cache: repeat bench invocations skip the
     # ~20-40s first-compile cost
     import jax

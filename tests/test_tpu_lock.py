@@ -1,0 +1,231 @@
+"""One-TPU-process lockfile guard (VERDICT r2 #1).
+
+The suite runs CPU-forced, so ``acquire()`` with default ``force_cpu_ok``
+is a documented no-op here; the lock mechanics are exercised with
+``force_cpu_ok=False``. Cross-process exclusion and crash-release are
+tested against REAL subprocess holders (flock semantics, not simulated
+PID files — the file contents are advisory, the kernel lock is the truth).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpu_dist.comm import tpu_lock
+
+_HOLDER_SRC = """
+import sys, time
+from tpu_dist.comm import tpu_lock
+lock = tpu_lock.acquire(owner="subproc_holder", path=sys.argv[1], force_cpu_ok=False)
+print("HELD", flush=True)
+time.sleep(float(sys.argv[2]) if len(sys.argv) > 2 else 60)
+"""
+
+
+def _spawn_holder(lock_path, hold_s=60.0):
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _HOLDER_SRC, str(lock_path), str(hold_s)],
+        stdout=subprocess.PIPE,
+        text=True,
+        cwd="/root/repo",
+    )
+    assert proc.stdout.readline().strip() == "HELD"
+    return proc
+
+
+@pytest.fixture
+def lock_path(tmp_path):
+    return str(tmp_path / "tpu.lock")
+
+
+@pytest.fixture(autouse=True)
+def _clear_held():
+    # isolate the process-local reentrancy state between tests
+    for lock in list(tpu_lock._held.values()):
+        lock.release()
+    yield
+    for lock in list(tpu_lock._held.values()):
+        lock.release()
+
+
+def test_cpu_forced_is_noop(lock_path):
+    # conftest forces jax_platforms=cpu -> acquiring is a no-op
+    assert tpu_lock.tpu_possible() is False
+    assert tpu_lock.acquire(owner="t", path=lock_path) is None
+    assert not os.path.exists(lock_path)
+
+
+def test_acquire_writes_pid_and_owner(lock_path):
+    lock = tpu_lock.acquire(owner="bench", path=lock_path, force_cpu_ok=False)
+    assert lock is not None
+    with open(lock_path) as f:
+        pid_line, owner_line = f.read().splitlines()[:2]
+    assert int(pid_line) == os.getpid()
+    assert owner_line == "bench"
+    lock.release()
+
+
+def test_reentrant_same_process(lock_path):
+    a = tpu_lock.acquire(owner="trainer", path=lock_path, force_cpu_ok=False)
+    b = tpu_lock.acquire(owner="bench", path=lock_path, force_cpu_ok=False)
+    assert b is a  # second acquire in the same process: same handle
+    a.release()
+
+
+def test_live_holder_refused_with_clear_message(lock_path):
+    holder = _spawn_holder(lock_path)
+    try:
+        with pytest.raises(tpu_lock.TPULockError) as ei:
+            tpu_lock.acquire(owner="me", path=lock_path, force_cpu_ok=False)
+        msg = str(ei.value)
+        assert str(holder.pid) in msg and "subproc_holder" in msg
+        assert "Refusing" in msg
+    finally:
+        holder.kill()
+        holder.wait()
+
+
+def test_clean_exit_releases_for_next_process(lock_path):
+    holder = _spawn_holder(lock_path, hold_s=0.2)
+    holder.wait()
+    lock = tpu_lock.acquire(owner="next", path=lock_path, force_cpu_ok=False)
+    assert lock is not None
+    lock.release()
+
+
+def test_sigkilled_holder_does_not_block(lock_path):
+    # the round-1/2 failure mode: a SIGKILLed TPU process must not leave a
+    # stale lock — flock is kernel-released on process death
+    holder = _spawn_holder(lock_path)
+    holder.send_signal(signal.SIGKILL)
+    holder.wait()
+    deadline = time.time() + 5
+    lock = None
+    while time.time() < deadline:
+        try:
+            lock = tpu_lock.acquire(owner="next", path=lock_path, force_cpu_ok=False)
+            break
+        except tpu_lock.TPULockError:
+            time.sleep(0.05)
+    assert lock is not None, "lock not released after holder SIGKILL"
+    lock.release()
+
+
+def test_release_then_reacquire_same_process(lock_path):
+    a = tpu_lock.acquire(owner="a", path=lock_path, force_cpu_ok=False)
+    a.release()
+    b = tpu_lock.acquire(owner="b", path=lock_path, force_cpu_ok=False)
+    assert b is not None and b is not a
+    b.release()
+
+
+def test_reentrant_guard_is_per_path(lock_path, tmp_path):
+    a = tpu_lock.acquire(owner="a", path=lock_path, force_cpu_ok=False)
+    other = str(tmp_path / "other.lock")
+    b = tpu_lock.acquire(owner="a2", path=other, force_cpu_ok=False)
+    assert b is not None and b is not a  # different path -> real new lock
+    # re-acquiring the FIRST path again must still be the no-op handle,
+    # not a self-refusal via a second open file description
+    a2 = tpu_lock.acquire(owner="a3", path=lock_path, force_cpu_ok=False)
+    assert a2 is a
+    a.release()
+    b.release()
+
+
+def test_reentrancy_normalizes_path_spelling(lock_path):
+    a = tpu_lock.acquire(owner="a", path=lock_path, force_cpu_ok=False)
+    alias = os.path.dirname(lock_path) + "//" + os.path.basename(lock_path)
+    b = tpu_lock.acquire(owner="b", path=alias, force_cpu_ok=False)
+    assert b is a  # same inode via another spelling: no self-refusal
+    a.release()
+
+
+def test_unopenable_lock_raises_lock_error(lock_path, monkeypatch):
+    # EACCES on open (another user's lockfile) must be a clean TPULockError
+    # refusal, not a traceback; chmod can't simulate it under root, so
+    # patch the open call
+    def deny(*a, **k):
+        raise PermissionError(13, "Permission denied")
+
+    monkeypatch.setattr(tpu_lock.os, "open", deny)
+    with pytest.raises(tpu_lock.TPULockError) as ei:
+        tpu_lock.acquire(owner="x", path=lock_path, force_cpu_ok=False)
+    assert "cannot open TPU lock" in str(ei.value)
+
+
+def test_context_manager_releases(lock_path):
+    with tpu_lock.acquire(owner="cm", path=lock_path, force_cpu_ok=False):
+        # lock is held: a contender must be refused
+        with pytest.raises(tpu_lock.TPULockError):
+            rc = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import sys\n"
+                    "from tpu_dist.comm import tpu_lock\n"
+                    "tpu_lock.acquire(owner='x', path=sys.argv[1], force_cpu_ok=False)\n",
+                    lock_path,
+                ],
+                cwd="/root/repo",
+                capture_output=True,
+                text=True,
+            )
+            if rc.returncode != 0 and "TPULockError" in rc.stderr:
+                raise tpu_lock.TPULockError(rc.stderr)
+    # after exit: a fresh process can take it
+    rc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import sys\n"
+            "from tpu_dist.comm import tpu_lock\n"
+            "assert tpu_lock.acquire(owner='x', path=sys.argv[1], force_cpu_ok=False)\n",
+            lock_path,
+        ],
+        cwd="/root/repo",
+        capture_output=True,
+    )
+    assert rc.returncode == 0, rc.stderr
+
+
+def test_guard_or_exit_exits_4(lock_path):
+    holder = _spawn_holder(lock_path)
+    try:
+        orig_path, orig_fn = tpu_lock.DEFAULT_LOCK_PATH, tpu_lock.tpu_possible
+        tpu_lock.DEFAULT_LOCK_PATH = lock_path
+        tpu_lock.tpu_possible = lambda: True  # simulate a TPU-possible run
+        try:
+            with pytest.raises(SystemExit) as ei:
+                tpu_lock.guard_or_exit("bench")
+            assert ei.value.code == 4
+        finally:
+            tpu_lock.DEFAULT_LOCK_PATH = orig_path
+            tpu_lock.tpu_possible = orig_fn
+    finally:
+        holder.kill()
+        holder.wait()
+
+
+def test_trainer_cpu_config_does_not_contend(tmp_path):
+    # integration: constructing a Trainer under the CPU-forced suite must
+    # not create the machine lock (no contention with a real TPU run)
+    from tests.helpers import tiny_resnet
+    from tpu_dist.config import TrainConfig
+    from tpu_dist.train.trainer import Trainer, register_model
+
+    register_model("tiny_resnet", lambda num_classes=10: tiny_resnet(num_classes))
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet", num_classes=10,
+        batch_size=64, epochs=1, steps_per_epoch=2, synthetic_n=128,
+        ckpt_dir=str(tmp_path),
+    )
+    existed_before = os.path.exists(tpu_lock.DEFAULT_LOCK_PATH)
+    t = Trainer(cfg)
+    assert t._tpu_lock is None
+    # no lockfile created by this CPU-forced construction (the path may
+    # pre-exist from a real TPU run on this machine — flock files persist)
+    assert os.path.exists(tpu_lock.DEFAULT_LOCK_PATH) == existed_before

@@ -1,0 +1,203 @@
+"""One-TPU-process discipline, enforced in code.
+
+The single tunneled TPU chip in this environment wedges irrecoverably when
+two PJRT clients touch it concurrently (BENCH_NOTES.md rounds 1 and 2: both
+driver bench artifacts were lost this way). The rule used to live in prose;
+this module puts it where it can't be forgotten: a machine-wide lockfile
+acquired by every TPU-touching entrypoint (``bench.py``, a TPU-backend
+:class:`~tpu_dist.train.trainer.Trainer`, ``__graft_entry__`` probes)
+*before* the JAX backend initializes — it is the PJRT client construction
+itself that claims the tunnel, so checking after ``jax.devices()`` would be
+too late.
+
+Mechanism: ``fcntl.flock(LOCK_EX | LOCK_NB)`` on a well-known path. The
+kernel releases the lock when the holder exits *for any reason* (including
+SIGKILL), so a crashed run never blocks the next one — no stale-PID
+heuristics, and none of the check-then-unlink races a PID-file scheme has.
+The file's pid/owner content exists only to produce a helpful refusal
+message; mutual exclusion is the kernel lock, not the file contents. The
+lock rides the open file description, which ``fork()`` shares — a forked
+child exiting does not drop the parent's claim.
+
+A second TPU-touching process refuses to start with a clear message naming
+the live holder instead of silently wedging the tunnel for the rest of the
+round.
+
+No reference counterpart: the reference's GPUs are process-exclusive by
+CUDA context anyway; the closest analogue is ``torch.distributed``'s
+rendezvous refusing a second world on one port.
+"""
+
+from __future__ import annotations
+
+import atexit
+import fcntl
+import os
+import sys
+from typing import Optional
+
+DEFAULT_LOCK_PATH = "/tmp/tpu_dist.tpu.lock"
+
+# Process-local state, keyed by lock path: acquiring a path this process
+# already holds is a no-op (Trainer inside bench.py, probes inside a
+# Trainer script, ...). flock would otherwise deny our own second open
+# file description on the same file.
+_held: dict = {}
+
+
+class TPULockError(RuntimeError):
+    """Another live process holds the TPU. Message names its PID/owner."""
+
+
+def tpu_possible() -> bool:
+    """Could initializing JAX in this process touch the TPU tunnel?
+
+    Reads the platform selection WITHOUT initializing any backend: the env
+    var and ``jax.config.jax_platforms`` (which the test conftest sets to
+    ``cpu`` — importing jax does not construct PJRT clients). Only an
+    unambiguous CPU-only selection returns False; unset/ambiguous selections
+    are treated as TPU-possible, which errs on the safe side.
+    """
+    selections = []
+    env = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if env:
+        selections.append(env)
+    try:
+        import jax
+
+        cfg = jax.config.jax_platforms  # type: ignore[attr-defined]
+        if cfg:
+            selections.append(str(cfg).strip().lower())
+    except Exception:  # pragma: no cover - jax always importable here
+        pass
+    if not selections:
+        return True
+    # the *effective* selection is the config value when set, else env;
+    # jax.config reflects the env var at import, so the last entry wins
+    effective = selections[-1]
+    plats = [p.strip() for p in effective.split(",") if p.strip()]
+    return not plats or any(p != "cpu" for p in plats)
+
+
+class TPULock:
+    """Handle for a held lock; release via :meth:`release` or process exit
+    (the kernel drops a dead holder's flock automatically)."""
+
+    def __init__(self, path: str, owner: str, fd: int):
+        self.path = path
+        self.owner = owner
+        self.pid = os.getpid()
+        self._fd = fd
+        self._released = False
+
+    def release(self) -> None:
+        # fork guard: a child inheriting this handle via atexit must not
+        # act on the parent's lock (closing the child's fd copy would not
+        # drop the flock anyway — it rides the shared open file
+        # description — but keep the state bookkeeping parent-only)
+        if self._released or os.getpid() != self.pid:
+            return
+        self._released = True
+        if _held.get(self.path) is self:
+            del _held[self.path]
+        try:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+        except OSError:
+            pass
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass
+        # The file deliberately stays on disk: unlinking a flock'd path
+        # races with a contender that already opened the old inode.
+        # "File exists" does not mean "held" — the flock does.
+
+    def __enter__(self) -> "TPULock":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def acquire(
+    owner: str = "tpu_dist",
+    path: Optional[str] = None,
+    force_cpu_ok: bool = True,
+) -> Optional[TPULock]:
+    """Acquire the machine-wide TPU lock, or raise :class:`TPULockError`.
+
+    Returns ``None`` (no-op) when this process is unambiguously CPU-only
+    and ``force_cpu_ok`` — CPU test runs must not contend. Re-acquiring the
+    same path in a process that already holds it returns the existing
+    handle.
+    """
+    if path is None:
+        path = DEFAULT_LOCK_PATH  # resolved at call time (testable)
+    # normalize: two spellings/symlinks of one file must hit the same
+    # reentrancy slot, or flock would refuse our own second descriptor
+    path = os.path.realpath(path)
+    if force_cpu_ok and not tpu_possible():
+        return None
+    existing = _held.get(path)
+    if existing is not None and not existing._released:
+        return existing
+
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+    except OSError as e:
+        # e.g. EACCES: lockfile owned by another user — still a clean
+        # refusal, not a traceback
+        raise TPULockError(
+            f"cannot open TPU lock {path}: {e}. If another user's run "
+            "created it, coordinate or choose a different lock path."
+        )
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError as e:
+        import errno as _errno
+
+        held_by_other = e.errno in (_errno.EWOULDBLOCK, _errno.EAGAIN, _errno.EACCES)
+        # locked by a live process: read its pid/owner for the message.
+        # Best-effort — content is advisory, the flock is the truth; a
+        # fresh winner may not have written its pid yet, so re-read once
+        # after a beat to avoid naming the PREVIOUS (dead) holder.
+        import time as _time
+
+        try:
+            data = os.pread(fd, 256, 0).decode(errors="replace").splitlines()
+            _time.sleep(0.05)
+            data2 = os.pread(fd, 256, 0).decode(errors="replace").splitlines()
+            if data2:
+                data = data2
+        except OSError:
+            data = []
+        finally:
+            os.close(fd)
+        if not held_by_other:  # ENOLCK etc.: infrastructure, not a holder
+            raise TPULockError(f"flock on TPU lock {path} failed: {e}")
+        holder_pid = data[0] if data else "?"
+        holder_owner = data[1] if len(data) > 1 else "?"
+        raise TPULockError(
+            f"TPU is held by live process {holder_pid} "
+            f"(owner: {holder_owner}, lock: {path}). Refusing to "
+            "start a second TPU client — concurrent clients wedge "
+            "the tunnel for the rest of the session. Wait for it "
+            "to finish, or kill it and retry."
+        )
+    # we hold it: record pid/owner for contenders' error messages
+    os.ftruncate(fd, 0)
+    os.pwrite(fd, f"{os.getpid()}\n{owner}\n".encode(), 0)
+    lock = TPULock(path, owner, fd)
+    _held[path] = lock
+    atexit.register(lock.release)
+    return lock
+
+
+def guard_or_exit(owner: str, exit_code: int = 4) -> Optional[TPULock]:
+    """CLI-entrypoint wrapper: acquire or print the holder message to stderr
+    and exit with ``exit_code`` (distinct from bench's 3 = tunnel timeout)."""
+    try:
+        return acquire(owner)
+    except TPULockError as e:
+        print(f"{owner}: {e}", file=sys.stderr, flush=True)
+        raise SystemExit(exit_code)
