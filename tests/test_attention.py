@@ -158,3 +158,57 @@ def test_ulysses_rejects_indivisible_heads():
                 check_vma=False,
             )
         )(q, k, v)
+
+
+def test_explicit_impl_overrides_process_default(monkeypatch):
+    """ADVICE r2: the step closure pins attn_impl at build time; an explicit
+    impl= must win over the process-global default at trace time."""
+    from tpu_dist.nn.vit import vit_tiny
+
+    model = vit_tiny(num_classes=4, image_size=16)
+    params, state = model.init(jax.random.PRNGKey(0))
+    x = jnp.zeros((2, 16, 16, 3), jnp.float32)
+
+    calls = []
+    import tpu_dist.ops.flash_attention as fa
+
+    real = fa.flash_attention
+    monkeypatch.setattr(
+        fa, "flash_attention",
+        lambda *a, **k: (calls.append(1), real(*a, **k))[1],
+    )
+
+    # global default says flash; explicit xla must NOT hit the kernel
+    A.set_default_attention_impl("flash")
+    try:
+        model.apply(params, state, x, attn_impl="xla")
+        assert not calls
+        # and explicit flash hits it even when the global says xla
+        A.set_default_attention_impl("xla")
+        model.apply(params, state, x, attn_impl="flash")
+        assert calls
+    finally:
+        A.set_default_attention_impl("xla")
+
+
+def test_trainer_snapshots_attn_impl():
+    """Two Trainers with different flash settings: each step closure keeps
+    its own impl (the global default no longer leaks across builds)."""
+    from tests.helpers import tiny_resnet
+    from tpu_dist.config import TrainConfig
+    from tpu_dist.train.trainer import Trainer, register_model
+
+    register_model("tiny_resnet", lambda num_classes=10: tiny_resnet(num_classes))
+    common = dict(
+        dataset="synthetic", model="vit_tiny", num_classes=10, batch_size=64,
+        epochs=1, steps_per_epoch=2, synthetic_n=128, sync_bn=False,
+    )
+    t_xla = Trainer(TrainConfig(**common))
+    t_flash = Trainer(TrainConfig(**common, flash_attention=True))
+    assert t_xla._attn_model_kwargs() == {"attn_impl": "xla"}
+    assert t_flash._attn_model_kwargs() == {"attn_impl": "flash"}
+    # conv models don't take the kwarg at all
+    t_conv = Trainer(TrainConfig(dataset="synthetic", model="tiny_resnet",
+                                 num_classes=10, batch_size=64, epochs=1,
+                                 steps_per_epoch=2, synthetic_n=128))
+    assert t_conv._attn_model_kwargs() == {}

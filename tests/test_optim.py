@@ -48,7 +48,8 @@ def test_adamw_matches_optax():
 
     from tpu_dist.train.optim import AdamW
 
-    opt = AdamW(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+    # decay_mask="all" matches optax.adamw's unmasked default exactly
+    opt = AdamW(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01, decay_mask="all")
     ref = optax.adamw(
         learning_rate=0.02, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01
     )
@@ -69,6 +70,38 @@ def test_adamw_matches_optax():
         updates, ref_s = ref.update(grads, ref_s, ref_p)
         ref_p = optax.apply_updates(ref_p, updates)
 
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ours_p), jax.tree_util.tree_leaves(ref_p)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_adamw_auto_mask_matches_optax_masked():
+    """Default decay_mask='auto' == optax.adamw with the standard
+    rank>1 mask: biases/norm scales get no decay (ADVICE r2)."""
+    import optax
+
+    from tpu_dist.train.optim import AdamW
+
+    opt = AdamW(weight_decay=0.05)
+    mask = lambda params: jax.tree_util.tree_map(lambda p: p.ndim > 1, params)
+    ref = optax.adamw(learning_rate=0.02, weight_decay=0.05, mask=mask)
+
+    params = {
+        "w": jnp.asarray(np.random.default_rng(0).normal(size=(4, 3)), jnp.float32),
+        "b": jnp.ones((3,), jnp.float32),  # nonzero so decay would show
+        "ln": {"scale": jnp.ones((4,), jnp.float32)},
+    }
+    ours_p, ours_s = params, opt.init(params)
+    ref_p, ref_s = params, ref.init(params)
+    rng = np.random.default_rng(1)
+    for _ in range(5):
+        grads = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(rng.normal(size=p.shape), jnp.float32), params
+        )
+        ours_p, ours_s = opt.update(grads, ours_s, ours_p, 0.02)
+        updates, ref_s = ref.update(grads, ref_s, ref_p)
+        ref_p = optax.apply_updates(ref_p, updates)
     for a, b in zip(
         jax.tree_util.tree_leaves(ours_p), jax.tree_util.tree_leaves(ref_p)
     ):

@@ -229,3 +229,29 @@ def test_trainer_cpu_config_does_not_contend(tmp_path):
     # no lockfile created by this CPU-forced construction (the path may
     # pre-exist from a real TPU run on this machine — flock files persist)
     assert os.path.exists(tpu_lock.DEFAULT_LOCK_PATH) == existed_before
+
+
+def test_failed_trainer_construction_releases_lock(tmp_path, monkeypatch):
+    """A constructor that raises (config validation) must not hold the TPU
+    lock for the rest of the process (code-review r3)."""
+    from tpu_dist.config import TrainConfig
+    from tpu_dist.train.trainer import Trainer
+
+    acquired, released = [], []
+
+    class FakeLock:
+        def release(self):
+            released.append(1)
+
+    def fake_acquire(owner="x", path=None, force_cpu_ok=True):
+        acquired.append(owner)
+        return FakeLock()
+
+    monkeypatch.setattr(tpu_lock, "acquire", fake_acquire)
+    cfg = TrainConfig(
+        dataset="synthetic", model="vit_tiny", num_classes=10, batch_size=32,
+        sync_bn=False, fsdp=True, flash_attention=True,  # guarded combo
+    )
+    with pytest.raises(ValueError, match="flash_attention"):
+        Trainer(cfg)
+    assert acquired and released  # lock taken, then given back on the raise

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Axon-tunnel recovery watcher (round-1/2 lesson: the tunnel can wedge for
+# hours; probe it with SINGLE bounded attempts, never concurrently).
+# On recovery: capture the driver-contract benchmark once, then exit so the
+# operator owns the (healthy) tunnel again. Mutual exclusion with any other
+# TPU-touching process comes from tpu_dist.comm.tpu_lock inside the probe.
+cd /root/repo || exit 2
+N=${1:-120}
+OUT=${2:-/tmp/BENCH_EARLY_r03.json}
+for i in $(seq 1 "$N"); do
+  ts=$(date -u +%F_%H:%M:%S)
+  timeout -k 10 300 python - <<'EOF'
+from tpu_dist.comm import tpu_lock
+tpu_lock.guard_or_exit("tpu_watch")
+import jax
+d = jax.devices()
+assert d and d[0].platform != "cpu", d
+print("ALIVE", d, flush=True)
+EOF
+  rc=$?
+  echo "$ts attempt $i rc=$rc" >> /tmp/tpu_watch.log
+  if [ "$rc" -eq 0 ]; then
+    echo "$ts tunnel ALIVE - capturing default bench" >> /tmp/tpu_watch.log
+    timeout -k 10 1200 python bench.py > "$OUT" 2>/tmp/bench_early.err
+    echo "$ts bench rc=$? out=$(cat "$OUT")" >> /tmp/tpu_watch.log
+    exit 0
+  fi
+  sleep 240
+done
+echo "$(date -u +%F_%H:%M:%S) exhausted $N attempts" >> /tmp/tpu_watch.log
+exit 1

@@ -188,6 +188,12 @@ class AsyncCheckpointer:
         keep_last: Optional[int] = None,
         extra_meta: Optional[dict] = None,
     ) -> Optional[str]:
+        """Snapshot synchronously, write in the background; returns the
+        EVENTUAL path. The file exists only after the background write
+        publishes — call :meth:`wait` (or :meth:`close`) before reading
+        the path or relying on it surviving a crash; write errors surface
+        on the next save/wait/close, not here. The Trainer drains via
+        ``wait()`` at epoch boundaries and ``close()`` on exit."""
         flat = _flatten(state._asdict())  # sync: collective + host snapshot
         if jax.process_index() != 0:
             return None
@@ -208,6 +214,8 @@ class AsyncCheckpointer:
         metric: float,
         extra_meta: Optional[dict] = None,
     ) -> Optional[str]:
+        """Best-model twin of :meth:`save` — same EVENTUAL-path contract:
+        the returned path is valid only after :meth:`wait`/:meth:`close`."""
         flat = _flatten(state._asdict())
         if jax.process_index() != 0:
             return None

@@ -31,6 +31,7 @@ class TrainConfig:
     optimizer: str = "sgd"         # sgd (reference, distributed.py:63) | adamw
     momentum: float = 0.9          # distributed.py:63 (sgd only)
     weight_decay: float = 1e-4     # distributed.py:63
+    adamw_decay_mask: str = "auto" # auto: skip rank<=1 leaves | all: decay every leaf
     lr_schedule: str = "multistep" # multistep (reference) | cosine
     lr_milestones: Tuple[int, ...] = (60, 120, 160)  # distributed.py:64
     lr_gamma: float = 0.2          # distributed.py:64
@@ -140,6 +141,13 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "decay; the transformer default)")
     p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--weight_decay", type=float, default=d.weight_decay)
+    p.add_argument("--adamw_decay_mask", choices=("auto", "all"),
+                   default=d.adamw_decay_mask,
+                   help="adamw only: 'auto' (default) skips weight decay on "
+                        "rank<=1 leaves (biases/norm scales, standard "
+                        "transformer practice); 'all' decays every leaf "
+                        "(pre-r3 behavior — use when resuming a pre-r3 "
+                        "adamw run)")
     p.add_argument("--lr_schedule", choices=("multistep", "cosine"), default=d.lr_schedule)
     p.add_argument("--warmup_epochs", type=int, default=d.warmup_epochs,
                    help="linear warmup epochs (cosine schedule only)")
@@ -151,7 +159,9 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--fused_epoch", action="store_true",
                    help="device-resident data: one jit call per epoch")
     p.add_argument("--shard_weight_update", "--zero1", action="store_true",
-                   help="ZeRO-1 weight-update sharding (arXiv:2004.13336)")
+                   help="ZeRO-1 weight-update sharding (arXiv:2004.13336); "
+                        "plain-DP SGD fast path by design — use --fsdp for "
+                        "anything beyond that")
     p.add_argument("--fsdp", action="store_true",
                    help="fully-sharded data parallelism (ZeRO-3): params and "
                         "momentum sharded over the data axis via GSPMD")

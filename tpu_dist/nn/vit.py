@@ -62,15 +62,17 @@ def patchify(x, patch_size: int):
     return x.reshape(b, (h // ph) * (w // pw), ph * pw * c)
 
 
-def block_forward(blk, t, heads: int):
+def block_forward(blk, t, heads: int, attn_impl: Optional[str] = None):
     """One standard (full-attention) transformer block on [B, S, D].
-    Shared by ViTDef's sequential path and the pipeline-parallel wrapper."""
+    Shared by ViTDef's sequential path and the pipeline-parallel wrapper.
+    ``attn_impl`` pins the attention implementation at build time (None =
+    process default at trace time)."""
     b, s, dim = t.shape
     h_dim = dim // heads
     y = _ln_apply(blk["ln1"], t)
     qkv = _dense(blk["qkv"], y).reshape(b, s, heads, 3, h_dim)
     q, k, v = (qkv[:, :, :, i, :] for i in range(3))
-    o = attn_lib.full_attention(q, k, v)
+    o = attn_lib.full_attention(q, k, v, impl=attn_impl)
     t = t + _dense(blk["proj"], o.reshape(b, s, dim))
     y = _ln_apply(blk["ln2"], t)
     y = jax.nn.gelu(_dense(blk["mlp1"], y))
@@ -172,6 +174,7 @@ class ViTDef:
         tp_axis: Optional[str] = None,
         tokens: Optional[jnp.ndarray] = None,
         pos_offset: int = 0,
+        attn_impl: Optional[str] = None,
     ):
         """Forward. Either ``x`` as images [B,H,W,3] (patchified here) or
         pre-sharded ``tokens`` [B, S_local, patch_dim] for sequence-parallel
@@ -228,7 +231,9 @@ class ViTDef:
             # layout [heads, 3, h_dim]: a contiguous column shard is whole heads
             qkv = qkv.reshape(b, s, h_loc, 3, h_dim)
             q, k, v = (qkv[:, :, :, i, :] for i in range(3))
-            o = attn_lib.attention(q, k, v, seq_axis=seq_axis, sp_mode=sp_mode)
+            o = attn_lib.attention(
+                q, k, v, seq_axis=seq_axis, sp_mode=sp_mode, impl=attn_impl
+            )
             proj = reduce_from_tp(_dense_local(blk["proj"], o.reshape(b, s, h_loc * h_dim)))
             t = t + proj + blk["proj"]["b"].astype(t.dtype)
             y = copy_to_tp(_ln_apply(blk["ln2"], t))

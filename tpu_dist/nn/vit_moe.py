@@ -113,6 +113,7 @@ class ViTMoEDef:
         train: bool = False,
         axis_name: Optional[str] = None,  # unused (no BN); contract parity
         ep_axis: Optional[str] = None,
+        attn_impl: Optional[str] = None,
     ):
         """``ep_axis`` set: the batch arrives sharded over BOTH the data and
         expert axes (the expert axis doubles as a data axis everywhere
@@ -139,7 +140,7 @@ class ViTMoEDef:
             s = qkv.shape[1]
             qkv = qkv.reshape(b, s, self.heads, 3, h_dim)
             q, k, v = (qkv[:, :, :, i, :] for i in range(3))
-            o = attn_lib.full_attention(q, k, v)
+            o = attn_lib.full_attention(q, k, v, impl=attn_impl)
             t = t + _dense(blk["proj"], o.reshape(b, s, self.dim))
 
             y = _ln_apply(blk["ln2"], t)

@@ -133,11 +133,11 @@ class ViTPipelineDef:
         check_pos_capacity(t.shape[1], params["pos"], self.image_size, self.patch_size)
         return t + params["pos"][: t.shape[1]].astype(t.dtype)[None]
 
-    def _stage_scan(self, stage_blocks, t):
+    def _stage_scan(self, stage_blocks, t, attn_impl=None):
         """Run this stage's stacked blocks sequentially."""
 
         def body(h, blk):
-            return block_forward(blk, h, self.heads), None
+            return block_forward(blk, h, self.heads, attn_impl=attn_impl), None
 
         out, _ = lax.scan(body, t, stage_blocks)
         return out
@@ -156,6 +156,7 @@ class ViTPipelineDef:
         axis_name: Optional[str] = None,  # contract parity (no BN)
         pp_axis: Optional[str] = None,
         n_microbatches: int = 0,
+        attn_impl: Optional[str] = None,
     ):
         """Without ``pp_axis``: sequential scan over all blocks (reference
         semantics). With ``pp_axis``: ``params["blocks"]`` arrives holding
@@ -172,7 +173,7 @@ class ViTPipelineDef:
 
                 inv = np.argsort(perm)
                 blocks = jax.tree_util.tree_map(lambda a: a[inv], blocks)
-            t = self._stage_scan(blocks, t)
+            t = self._stage_scan(blocks, t, attn_impl)
             return self._finish(params, t), state
 
         n_stages = lax.axis_size(pp_axis)
@@ -194,7 +195,7 @@ class ViTPipelineDef:
                 params["blocks"],
             )
             outs = pipeline_apply_interleaved(
-                lambda blocks, h: self._stage_scan(blocks, h),
+                lambda blocks, h: self._stage_scan(blocks, h, attn_impl),
                 chunks,
                 micro,
                 pp_axis,
@@ -203,7 +204,7 @@ class ViTPipelineDef:
             )
         else:
             outs = pipeline_apply(
-                lambda blocks, h: self._stage_scan(blocks, h),
+                lambda blocks, h: self._stage_scan(blocks, h, attn_impl),
                 params["blocks"],
                 micro,
                 pp_axis,

@@ -149,6 +149,12 @@ def _fwd(q3, k3, v3, causal, block_q, block_k, interpret):
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
+        # only the innermost (k-block) dim carries softmax state between
+        # iterations; batch·heads and q-blocks are free for the TPU to
+        # parallelize/pipeline (ADVICE r2)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qp, kp, vp)
     return out[:, :s_q], m[:, :s_q], l[:, :s_q]

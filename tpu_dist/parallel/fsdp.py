@@ -116,9 +116,14 @@ def make_fsdp_train_step(
     grad_clip_norm: float = 0.0,
     moe_aux_coef: float = 0.01,
     remat: bool = False,
+    model_kwargs: dict | None = None,
 ):
     """Build ``step(state, images, labels, lr) -> (state, metrics)``, the
     FSDP twin of :func:`tpu_dist.train.step.make_train_step`.
+
+    ``model_kwargs``: extra keywords pinned into the model apply at build
+    time (e.g. ``attn_impl`` — the process-global attention default must
+    not leak into this trace).
 
     ``specs`` is the per-leaf param pytree from :func:`fsdp_specs`. The body
     is written entirely in the global view — no ``pmean``/``psum`` anywhere;
@@ -135,7 +140,9 @@ def make_fsdp_train_step(
         p = jax.tree_util.tree_map(lambda t: t.astype(compute_dtype), params)
         # axis_name=None: the mean/var in BN run over the global batch —
         # under GSPMD that IS cross-replica SyncBN (module docstring).
-        logits, new_bn = model_apply(p, bn_state, x, train=True, axis_name=None)
+        logits, new_bn = model_apply(
+            p, bn_state, x, train=True, axis_name=None, **(model_kwargs or {})
+        )
         from tpu_dist.train.step import extract_aux_loss  # noqa: PLC0415
 
         new_bn, aux = extract_aux_loss(new_bn)
@@ -235,6 +242,7 @@ def make_fsdp_eval_step(
     opt_specs=None,
     compute_dtype=jnp.float32,
     axis: str = mesh_lib.DATA_AXIS,
+    model_kwargs: dict | None = None,
 ):
     """FSDP twin of :func:`tpu_dist.train.step.make_eval_step` — identical
     contract (masked GLOBAL sums of loss/top1/top5/count, so the streaming
@@ -248,7 +256,10 @@ def make_fsdp_eval_step(
         p = jax.tree_util.tree_map(
             lambda t: t.astype(compute_dtype), state.params
         )
-        logits, _ = model_apply(p, state.bn_state, x, train=False, axis_name=None)
+        logits, _ = model_apply(
+            p, state.bn_state, x, train=False, axis_name=None,
+            **(model_kwargs or {})
+        )
         nll = F.cross_entropy(logits, labels, reduction="none")
         maxk = min(5, logits.shape[-1])
         _, pred = lax.top_k(logits.astype(jnp.float32), maxk)

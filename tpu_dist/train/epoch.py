@@ -81,6 +81,7 @@ def make_fused_epoch(
     mean: np.ndarray = CIFAR100_MEAN,
     std: np.ndarray = CIFAR100_STD,
     moe_aux_coef: float = 0.01,
+    model_kwargs: dict | None = None,
 ):
     """Build ``epoch(state, images_u8, labels, lr, epoch_idx) ->
     (state, metrics)`` running every step of the epoch on device.
@@ -115,7 +116,10 @@ def make_fused_epoch(
 
         def loss_fn(params, bn_state, x, y):
             p = jax.tree_util.tree_map(lambda t: t.astype(compute_dtype), params)
-            logits, new_bn = model_apply(p, bn_state, x, train=True, axis_name=bn_axis)
+            logits, new_bn = model_apply(
+                p, bn_state, x, train=True, axis_name=bn_axis,
+                **(model_kwargs or {})
+            )
             new_bn, aux = extract_aux_loss(new_bn)
             loss = F.cross_entropy(logits, y)
             if aux is not None:
@@ -165,6 +169,7 @@ def make_fused_eval(
     axis: str = mesh_lib.DATA_AXIS,
     mean: np.ndarray = CIFAR100_MEAN,
     std: np.ndarray = CIFAR100_STD,
+    model_kwargs: dict | None = None,
 ):
     """Whole-test-set evaluation as ONE jit call over device-resident data.
 
@@ -193,7 +198,8 @@ def make_fused_eval(
             sl = lambda t: lax.dynamic_slice_in_dim(t, i * batch_per_device, batch_per_device)
             x = (sl(imgs).astype(jnp.float32) / 255.0 - mean_c) * std_inv_c
             logits, _ = model_apply(
-                p, state.bn_state, x.astype(compute_dtype), train=False, axis_name=None
+                p, state.bn_state, x.astype(compute_dtype), train=False,
+                axis_name=None, **(model_kwargs or {})
             )
             y = sl(lbls)
             m = (y >= 0).astype(jnp.float32)

@@ -128,11 +128,29 @@ class AdamW:
         b2: float = 0.999,
         eps: float = 1e-8,
         weight_decay: float = 0.01,
+        decay_mask: str = "auto",
     ):
+        """``decay_mask``: which leaves get decoupled weight decay.
+        ``"auto"`` (default) follows standard transformer practice — skip
+        rank ≤ 1 leaves (biases, LayerNorm/BN scales, 1-D tables), decay
+        matrices/conv kernels. ``"all"`` decays every leaf (optax.adamw's
+        unmasked behavior)."""
+        if decay_mask not in ("auto", "all"):
+            raise ValueError(f"decay_mask must be 'auto' or 'all', got {decay_mask!r}")
         self.b1 = b1
         self.b2 = b2
         self.eps = eps
         self.weight_decay = weight_decay
+        self.decay_mask = decay_mask
+
+    def _wd_tree(self, params):
+        """Per-leaf effective weight decay (0.0 for masked-out leaves)."""
+        wd = self.weight_decay
+        if self.decay_mask == "all":
+            return jax.tree_util.tree_map(lambda p: wd, params)
+        return jax.tree_util.tree_map(
+            lambda p: wd if jnp.ndim(p) > 1 else 0.0, params
+        )
 
     def init(self, params):
         zeros = lambda: jax.tree_util.tree_map(
@@ -148,7 +166,7 @@ class AdamW:
 
     def update(self, grads, opt_state, params, lr):
         """Returns ``(new_params, new_opt_state)``; ``lr`` may be traced."""
-        b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
+        b1, b2, eps = self.b1, self.b2, self.eps
         tm = jax.tree_util.tree_map
         count = opt_state["count"] + 1
         cf = count.astype(jnp.float32)
@@ -158,8 +176,8 @@ class AdamW:
         mu = tm(lambda m, g: b1 * m + (1.0 - b1) * g, opt_state["mu"], grads)
         nu = tm(lambda v, g: b2 * v + (1.0 - b2) * jnp.square(g), opt_state["nu"], grads)
         new_params = tm(
-            lambda p, m, v: p - lr * ((m / bc1) / (jnp.sqrt(v / bc2) + eps) + wd * p),
-            params, mu, nu,
+            lambda p, m, v, wd: p - lr * ((m / bc1) / (jnp.sqrt(v / bc2) + eps) + wd * p),
+            params, mu, nu, self._wd_tree(params),
         )
         return new_params, {"mu": mu, "nu": nu, "count": count}
 
