@@ -273,6 +273,116 @@ def _run_fused(cfg: BenchConfig, mesh, model, optimizer, state, n_dev: int, batc
     }
 
 
+def run_pp(cfg: BenchConfig, steps: int, warmup: int, pp: int,
+           interleave: int, microbatches: int, dims: str = "b16") -> dict:
+    """Pipeline-parallel bench: ViT-B/16 split into ``pp`` stages over a
+    (data × pipe) mesh, GPipe (``interleave=1``) or interleaved virtual
+    stages, with the schedule's bubble fraction in the output line.
+
+    Needs ``pp`` to divide the visible device count — on the single-chip
+    TPU run it with CPU host-platform emulation
+    (``XLA_FLAGS=--xla_force_host_platform_device_count=8``); on a real
+    multi-chip slice it measures the ICI pipeline directly.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.nn.vit_pp import ViTPipelineDef
+    from tpu_dist.parallel.pipeline import bubble_fraction
+    from tpu_dist.train.optim import SGD
+    from tpu_dist.train.state import TrainState
+    from tpu_dist.train.step import make_train_step
+
+    if cfg.model != "vit_b16":
+        raise SystemExit("--pp bench supports --config vit_b16_imagenet only")
+    n = len(jax.devices())
+    if n % pp:
+        raise SystemExit(f"{n} devices not divisible by pp={pp}")
+    # tiny dims: smoke/validate the schedule on CPU emulation; b16: the
+    # real measurement shape
+    depth, dim, heads, patch, img = (
+        (12, 768, 12, 16, cfg.image_size) if dims == "b16"
+        else (8, 64, 4, 4, 32)
+    )
+    if depth % (pp * interleave):
+        raise SystemExit(
+            f"depth {depth} must divide into pp*interleave={pp * interleave} "
+            "equal chunks (try pp in {2,3,4,6,12}, interleave such that "
+            f"pp*interleave divides {depth})"
+        )
+    mesh = mesh_lib.device_mesh(
+        [n // pp, pp], [mesh_lib.DATA_AXIS, mesh_lib.PIPE_AXIS]
+    )
+    model = ViTPipelineDef(
+        image_size=img, patch_size=patch, dim=dim, depth=depth,
+        heads=heads, num_classes=cfg.num_classes,
+        interleave=interleave, pp_stages=pp if interleave > 1 else 0,
+    )
+    cfg = __import__("dataclasses").replace(cfg, image_size=img)
+    m = microbatches or pp
+    optimizer = SGD(momentum=0.9, weight_decay=1e-4)
+    params, st = model.init(jax.random.PRNGKey(0))
+    specs = model.pp_param_specs(mesh_lib.PIPE_AXIS)
+    state = TrainState(
+        params=mesh_lib.place_host_tree(mesh, params, specs),
+        bn_state=mesh_lib.place_host_tree(mesh, st),
+        opt_state=mesh_lib.place_host_tree(mesh, optimizer.init(params), specs),
+        step=mesh_lib.place_host_tree(mesh, jnp.zeros((), jnp.int32)),
+    )
+    step = make_train_step(
+        model.apply, optimizer, mesh, sync_bn=False,
+        compute_dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
+        pp_axis=mesh_lib.PIPE_AXIS, param_specs=specs,
+        model_kwargs={"n_microbatches": m} if microbatches else None,
+    )
+    batch = cfg.global_batch
+    n_data = n // pp
+    if (batch // n_data) % m:
+        batch = n_data * m * max(1, batch // (n_data * m))
+    rng = np.random.default_rng(0)
+    images = mesh_lib.shard_batch(
+        mesh, rng.normal(size=(batch, cfg.image_size, cfg.image_size, 3)).astype(np.float32)
+    )
+    labels = mesh_lib.shard_batch(
+        mesh, rng.integers(0, cfg.num_classes, batch).astype(np.int32)
+    )
+    try:
+        compiled = step.lower(state, images, labels, 0.1).compile()
+        flops = _step_flops(compiled)
+        call = compiled
+    except Exception:
+        flops = None
+        call = step
+    for _ in range(warmup):
+        state, metrics = call(state, images, labels, 0.1)
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = call(state, images, labels, 0.1)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+    img_per_sec = batch * steps / dt
+    return {
+        "metric": (
+            f"{cfg.name}_pp{pp}x{interleave}_m{m}"
+            + ("_tiny" if dims == "tiny" else "")
+            + "_train_throughput"
+        ),
+        "value": round(img_per_sec, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+        "n_devices": n,
+        "global_batch": batch,
+        "pp_stages": pp,
+        "pp_interleave": interleave,
+        "pp_microbatches": m,
+        "bubble_fraction": round(bubble_fraction(pp, m, interleave), 4),
+        "step_ms": round(1000 * dt / steps, 2),
+        "mfu": _mfu(flops, dt / steps, n),
+    }
+
+
 def _guarded_backend_init(timeout_s: float) -> None:
     """Fail loudly (exit 3) if device discovery hangs — a wedged TPU tunnel
     must not hang the calling harness forever."""
@@ -324,6 +434,23 @@ def main() -> None:
              "row per training mode, measured on the visible devices",
     )
     p.add_argument(
+        "--pp", type=int, default=0,
+        help="pipeline-parallel bench: split ViT-B/16 into N stages over a "
+             "(data x pipe) mesh; reports throughput + bubble_fraction "
+             "(run with CPU device-count emulation on single-chip hosts)",
+    )
+    p.add_argument("--pp_interleave", type=int, default=1)
+    p.add_argument(
+        "--pp_dims", choices=("b16", "tiny"), default="b16",
+        help="tiny swaps in a small ViT for schedule validation on CPU "
+             "emulation; b16 is the measurement shape",
+    )
+    p.add_argument(
+        "--pp_microbatches", type=int, default=0,
+        help="microbatches M >= stages (0 = one per stage); larger M "
+             "shrinks the bubble (S-1)/(vM+S-1)",
+    )
+    p.add_argument(
         "--scaling", action="store_true",
         help="run the config on 1,2,4,...,N-device meshes and report "
              "scaling efficiency (BASELINE's 1→8→32 chip metric; limited "
@@ -354,6 +481,14 @@ def main() -> None:
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
 
     _guarded_backend_init(args.init_timeout)
+    if args.pp:
+        cfg_name = args.config if args.config.startswith("vit") else "vit_b16_imagenet"
+        print(json.dumps(run_pp(
+            CONFIGS[cfg_name], args.steps, args.warmup,
+            args.pp, args.pp_interleave, args.pp_microbatches,
+            dims=args.pp_dims,
+        )))
+        return
     if args.table:
         # reference README comparison-table parity (README.md:59-77): one
         # row per training mode, same model/dataset, epoch seconds

@@ -236,7 +236,7 @@ def test_trainer_fsdp_flag_walls():
         synthetic_n=64, fsdp=True,
     )
     for bad in (
-        dict(tp=2, model="vit_tiny"),
+        dict(sp=2, model="vit_tiny"),  # sp/ep/pp stay refused; tp composes
         dict(shard_weight_update=True),
         dict(fused_epoch=True),
         dict(fused_optimizer=True),
@@ -244,3 +244,120 @@ def test_trainer_fsdp_flag_walls():
     ):
         with pytest.raises(ValueError):
             Trainer(TrainConfig(**base, **bad))
+
+
+# -- FSDP x TP (VERDICT r2 #5) -----------------------------------------------
+
+
+def _mesh_2d(tp=2):
+    n = len(jax.devices())
+    return mesh_lib.device_mesh(
+        [n // tp, tp], [mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS]
+    )
+
+
+def test_compose_fsdp_specs_overlay():
+    from tpu_dist.parallel.fsdp import compose_fsdp_specs
+
+    mesh = _mesh_2d(tp=2)  # data=4, model=2
+    params = {
+        "qkv_w": jnp.zeros((64, 192)),   # model on dim1 -> data on dim0
+        "proj_w": jnp.zeros((64, 64)),   # model on dim0 -> data on dim1
+        "free": jnp.zeros((128, 33)),    # no model spec -> data on dim0
+        "small_b": jnp.zeros((192,)),    # model on dim0, below min_size
+        "tiny": jnp.zeros((8,)),
+    }
+    mspecs = {
+        "qkv_w": P(None, "model"),
+        "proj_w": P("model", None),
+        "free": P(),
+        "small_b": P("model"),
+        "tiny": P(),
+    }
+    specs = compose_fsdp_specs(params, mesh, mspecs, min_size=1024)
+    assert specs["qkv_w"] == P("data", "model")
+    assert specs["proj_w"] == P("model", "data")
+    assert specs["free"] == P("data")
+    assert specs["small_b"] == P("model")  # model sharding preserved
+    assert specs["tiny"] == P()
+
+
+def test_fsdp_tp_matches_plain_dp():
+    """FSDP x TP (GSPMD spec overlay) must be arithmetically identical to
+    plain replicated DP: specs change the schedule, never the math."""
+    from tpu_dist.nn.vit import vit_tiny
+    from tpu_dist.parallel.fsdp import compose_fsdp_specs
+
+    model = vit_tiny(num_classes=10, image_size=16)
+    opt = SGD()
+    params, st = model.init(jax.random.PRNGKey(7))
+
+    mesh1 = _mesh()            # 8-way plain DP reference
+    mesh2 = _mesh_2d(tp=2)     # data=4 x model=2
+    specs = compose_fsdp_specs(
+        params, mesh2, model.tp_param_specs(mesh_lib.MODEL_AXIS), min_size=256
+    )
+    # the composition must actually use BOTH axes somewhere
+    flat = [tuple(s) for s in jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda s: isinstance(s, P))]
+    assert any("model" in f and "data" in f for f in flat), flat
+
+    plain = jax.device_put(
+        TrainState.create(params, st, opt), mesh_lib.replicated(mesh1)
+    )
+    fsdp = _fsdp_state(mesh2, params, st, opt, specs)
+    _assert_some_leaf_sharded(fsdp)
+
+    plain_step = make_train_step(model.apply, opt, mesh1, donate=False, sync_bn=False)
+    fsdp_step = make_fsdp_train_step(model.apply, opt, mesh2, specs, donate=False)
+
+    rng = np.random.default_rng(8)
+    for _ in range(3):
+        x = rng.normal(size=(32, 16, 16, 3)).astype(np.float32)
+        y = rng.integers(0, 10, 32).astype(np.int32)
+        plain, mp = plain_step(
+            plain, mesh_lib.shard_batch(mesh1, x), mesh_lib.shard_batch(mesh1, y), 0.1
+        )
+        fsdp, mf = fsdp_step(
+            fsdp, mesh_lib.shard_batch(mesh2, x), mesh_lib.shard_batch(mesh2, y), 0.1
+        )
+
+    for k in ("loss", "acc1", "acc5"):
+        np.testing.assert_allclose(float(mp[k]), float(mf[k]), rtol=1e-4, atol=1e-4)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(plain.params),
+        jax.tree_util.tree_leaves(fsdp.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_trainer_fsdp_tp_e2e_adamw(tmp_path):
+    """--fsdp --tp 2 trains, evals, checkpoints, resumes (AdamW state specs
+    composed through optimizer.state_specs)."""
+    from tpu_dist.config import TrainConfig
+    from tpu_dist.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        dataset="synthetic", model="vit_tiny", num_classes=10, batch_size=32,
+        epochs=1, steps_per_epoch=3, log_every=10, lr=0.01, eval_every=1,
+        fsdp=True, tp=2, sync_bn=False, optimizer="adamw",
+        ckpt_dir=str(tmp_path), save_every=1, synthetic_n=128,
+    )
+    t = Trainer(cfg)
+    # both mesh axes exist and params use the model axis somewhere
+    assert dict(t.mesh.shape) == {"data": 4, "model": 2}
+    flat = [
+        tuple(l.sharding.spec)
+        for l in jax.tree_util.tree_leaves(t.state.params)
+    ]
+    assert any("model" in f for f in flat), flat
+    assert any("data" in f for f in flat), flat
+    out = t.fit(1)
+    assert np.isfinite(out["loss"])
+    t2 = Trainer(cfg.replace(resume=True, epochs=2))
+    assert t2.start_epoch == 1
+    for a, b in zip(
+        jax.tree_util.tree_leaves(t.state.params),
+        jax.tree_util.tree_leaves(t2.state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)

@@ -78,6 +78,56 @@ def fsdp_specs(params, mesh: Mesh, axis: str = mesh_lib.DATA_AXIS, min_size: int
     return jax.tree_util.tree_map(spec, params)
 
 
+def compose_fsdp_specs(
+    params,
+    mesh: Mesh,
+    model_specs,
+    *,
+    data_axis: str = mesh_lib.DATA_AXIS,
+    min_size: int = 1024,
+):
+    """FSDP × TP spec composition (VERDICT r2 #5): overlay data-axis
+    (weight/optimizer-state) sharding onto existing MODEL-axis specs.
+
+    ``model_specs`` is the per-leaf pytree of Megatron-style specs (e.g.
+    ``ViTDef.tp_param_specs("model")``: qkv/mlp1 column-sharded, proj/mlp2
+    row-sharded). For each leaf, the largest dimension NOT already claimed
+    by a model axis and divisible by the data-axis size additionally shards
+    over ``data_axis`` — so a ``[D, 4D]`` mlp1 kernel on a (data=4, model=2)
+    mesh lands as ``P('data', 'model')``: each device holds 1/8 of it, the
+    GSPMD partitioner all-gathers over ``data`` at use time (FSDP) and
+    psums the row-parallel matmuls over ``model`` (TP). Leaves below
+    ``min_size`` or with no free divisible dim keep their model spec
+    unchanged — on the (replicated-over-data) model axis they behave like
+    plain TP params.
+
+    This is the GSPMD half of the framework's scaling story: no engine
+    change, only specs — compare ``train/step.py``'s hand-written
+    shard_map TP, which composes with ZeRO-style sharding only by explicit
+    per-shard layouts (scoped out; see the ZeRO-1 design note there).
+    """
+    n = int(mesh.shape[data_axis])
+
+    def compose(x, mspec):
+        shape = tuple(getattr(x, "shape", ()))
+        entries = list(tuple(mspec)) if mspec is not None else []
+        entries += [None] * (len(shape) - len(entries))
+        size = 1
+        for d in shape:
+            size *= int(d)
+        if n > 1 and shape and size >= min_size:
+            order = sorted(range(len(shape)), key=lambda d: (-int(shape[d]), d))
+            for d in order:
+                if entries[d] is None and int(shape[d]) % n == 0:
+                    entries[d] = data_axis
+                    break
+        while entries and entries[-1] is None:
+            entries.pop()
+        return P(*entries)
+
+    return jax.tree_util.tree_map(compose, params, model_specs)
+
+
 def _shardings(mesh: Mesh, specs):
     return jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s),

@@ -112,15 +112,20 @@ def pipeline_apply_interleaved(
     stages ``d, d+S, ..., d+(v-1)S`` — as stacked leading-dim-``v`` arrays
     in ``chunk_params_local``. A microbatch laps the ring ``v`` times.
 
-    Schedule (the zero-buffer case, requires ``M == n_stages``): device
-    ``d`` is busy ticks ``[d, d+vM)``; at relative tick ``r = t-d`` it runs
-    chunk ``k = r // M`` on microbatch ``m = r % M``. The producing virtual
-    stage emitted that activation on the previous tick — every handoff is
-    one nearest-neighbor ``ppermute``, arrivals land exactly when consumed,
-    so no activation buffer exists at all (the property that makes this
-    SPMD formulation clean). Total ``vM + S - 1`` chunk-ticks against
-    GPipe's ``v(M + S - 1)`` for the same per-device work: bubble
-    ``(S-1)/(vM+S-1)`` (see :func:`bubble_fraction`).
+    Schedule: device ``d`` is busy ticks ``[d, d+vM)``; at relative tick
+    ``r = t-d`` it runs chunk ``k = r // M`` on microbatch ``m = r % M``.
+    For devices ``d > 0`` every handoff is just-in-time: the producing
+    virtual stage ``(d-1, k)`` emitted that activation on the previous
+    tick, one nearest-neighbor ``ppermute`` away. The only early arrival
+    is the LAP boundary ``(S-1, k-1) → (0, k)``: it lands ``M - S`` ticks
+    before consumption, so a circular buffer of depth ``Q = M - S + 1``
+    rides the scan carry and absorbs it — ``M == S`` degenerates to
+    ``Q = 1``, the zero-buffer schedule. Per-device activation memory is
+    therefore ``Q`` microbatches (the buffered-handoff analogue of 1F1B's
+    in-flight window), while the tick count stays ``vM + S - 1`` against
+    GPipe's ``v(M + S - 1)``: bubble ``(S-1)/(vM+S-1)`` keeps SHRINKING
+    as M grows (see :func:`bubble_fraction`) instead of being pinned at
+    the ``M == S`` corner.
 
     Differentiation follows :func:`pipeline_apply`'s convention (per-device
     loss-replica grads inside ``shard_map``; conjugate ``tp_ops`` wrap
@@ -132,25 +137,38 @@ def pipeline_apply_interleaved(
 
     M = x_micro.shape[0]
     n, v = n_stages, interleave
-    if M != n:
+    if M < n:
         raise ValueError(
-            f"interleaved schedule requires n_microbatches == n_stages "
-            f"(zero-buffer handoffs); got M={M}, S={n}"
+            f"interleaved schedule requires n_microbatches >= n_stages "
+            f"(a microbatch laps the ring {v}x; fewer than S in flight "
+            f"starves the warmup ramp); got M={M}, S={n}"
         )
+    Q = M - n + 1  # lap-boundary buffer depth (1 == zero-buffer M==S case)
     copy_to_pipe, reduce_from_pipe = tp_ops(axis)
     x_micro = copy_to_pipe(x_micro)
     my = lax.axis_index(axis)
     total = v * M + n - 1
 
     def tick(carry, t):
-        h, outs = carry
+        h, buf, outs = carry
+        # ``h`` arrived over the ring at this tick: record it. Slots cycle
+        # every Q ticks; the wrap activation read Q-1 pushes later is still
+        # intact (its slot is untouched until exactly tick t + Q).
+        buf = lax.dynamic_update_index_in_dim(buf, h, jnp.mod(t, Q), 0)
         rel = t - my
         active = (rel >= 0) & (rel < v * M)
         relc = jnp.clip(rel, 0, v * M - 1)
         k = relc // M
         m = relc % M
-        # virtual stage 0 (device 0, chunk 0) ingests microbatch m
-        h_in = jnp.where((my == 0) & (k == 0), x_micro[m], h)
+        # devices d>0 consume this tick's arrival (delay 0 == the slot just
+        # written); device 0 consumes the lap-boundary arrival from M-S
+        # ticks ago
+        delay = jnp.where(my == 0, M - n, 0)
+        h_cons = lax.dynamic_index_in_dim(
+            buf, jnp.mod(t - delay, Q), 0, keepdims=False
+        )
+        # virtual stage 0 (device 0, chunk 0) ingests microbatch m instead
+        h_in = jnp.where((my == 0) & (k == 0), x_micro[m], h_cons)
         chunk = jax.tree_util.tree_map(
             lambda p: lax.dynamic_index_in_dim(p, k, 0, keepdims=False),
             chunk_params_local,
@@ -167,10 +185,11 @@ def pipeline_apply_interleaved(
         )
         perm = [(i, (i + 1) % n) for i in range(n)]
         h = lax.ppermute(y, axis, perm)
-        return (h, outs), None
+        return (h, buf, outs), None
 
     h0 = jnp.zeros_like(x_micro[0])
+    buf0 = jnp.zeros((Q,) + x_micro.shape[1:], x_micro.dtype)
     outs0 = jnp.zeros_like(x_micro)
-    (_, outs), _ = lax.scan(tick, (h0, outs0), jnp.arange(total))
+    (_, _, outs), _ = lax.scan(tick, (h0, buf0, outs0), jnp.arange(total))
     outs = reduce_from_pipe(jnp.where(my == n - 1, outs, jnp.zeros_like(outs)))
     return outs

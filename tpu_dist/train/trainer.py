@@ -220,10 +220,13 @@ class Trainer:
         self._param_specs = None
         self._fsdp_specs = None
         if cfg.fsdp:
-            if cfg.sp > 1 or cfg.tp > 1 or cfg.ep > 1 or cfg.pp > 1:
+            if cfg.sp > 1 or cfg.ep > 1 or cfg.pp > 1:
                 raise ValueError(
-                    "fsdp shards params/momentum over the data axis; it does "
-                    "not compose with sp/tp/ep/pp model axes"
+                    "fsdp composes with --tp (GSPMD spec overlay) but not "
+                    "with sp/ep/pp: the ring/all_to_all/pipeline engines "
+                    "are shard_map programs, and a leaf cannot be owned by "
+                    "both a hand-written collective schedule and the "
+                    "GSPMD partitioner"
                 )
             if cfg.fused_epoch or cfg.shard_weight_update:
                 raise ValueError(
@@ -324,10 +327,11 @@ class Trainer:
                 import dataclasses as _dc  # noqa: PLC0415
 
                 m_check = cfg.pp_microbatches or cfg.pp
-                if m_check != cfg.pp:
+                if m_check < cfg.pp:
                     raise ValueError(
-                        "pp_interleave > 1 requires pp_microbatches == pp "
-                        "(the zero-buffer interleaved schedule)"
+                        "pp_interleave > 1 requires pp_microbatches >= pp "
+                        "(fewer microbatches than stages starves the "
+                        "interleaved schedule's warmup ramp)"
                     )
                 if not (
                     _dc.is_dataclass(self.model)
@@ -500,10 +504,23 @@ class Trainer:
         state = TrainState.create(params, bn_state, self.optimizer)
         self._fsdp_opt_specs = None
         if cfg.fsdp:
-            from tpu_dist.parallel.fsdp import fsdp_specs  # noqa: PLC0415
+            from tpu_dist.parallel.fsdp import (  # noqa: PLC0415
+                compose_fsdp_specs,
+                fsdp_specs,
+            )
 
-            self._fsdp_specs = fsdp_specs(params, self.mesh)
-            self._fsdp_opt_specs = fsdp_specs(state.opt_state, self.mesh)
+            if cfg.tp > 1:
+                # FSDP × TP: overlay data-axis sharding on the model's
+                # Megatron specs; the GSPMD engine runs the PLAIN apply
+                # (no tp_axis/psum — the partitioner inserts collectives
+                # for both axes from the specs alone)
+                self._fsdp_specs = compose_fsdp_specs(
+                    params, self.mesh,
+                    self.model.tp_param_specs(mesh_lib.MODEL_AXIS),
+                )
+            else:
+                self._fsdp_specs = fsdp_specs(params, self.mesh)
+            self._fsdp_opt_specs = self.optimizer.state_specs(self._fsdp_specs)
         if cfg.shard_weight_update and cfg.fused_epoch:
             raise ValueError(
                 "shard_weight_update (ZeRO-1) is scoped to the plain DP "
