@@ -429,6 +429,14 @@ def main() -> None:
         default=float(os.environ.get("BENCH_INIT_TIMEOUT", "600")),
     )
     p.add_argument(
+        "--lock_wait", type=float,
+        default=float(os.environ.get("BENCH_LOCK_WAIT", "600")),
+        help="seconds to wait for the machine-wide TPU lock before giving "
+             "up with exit 4; a bounded probe/watcher releases it within "
+             "its own timeout, so waiting beats instant refusal (round-3 "
+             "driver bench died rc=4 exactly this way)",
+    )
+    p.add_argument(
         "--table", action="store_true",
         help="emit the reference README's comparison table (markdown), one "
              "row per training mode, measured on the visible devices",
@@ -467,12 +475,13 @@ def main() -> None:
             }
         )
 
-    # One-TPU-process rule: refuse (exit 4, clear holder message) rather
-    # than start a second PJRT client and wedge the tunnel. Must run before
-    # any backend init. No-op when the platform is forced to CPU.
+    # One-TPU-process rule: wait (bounded) for the machine-wide lock, then
+    # refuse (exit 4, clear holder message) rather than start a second PJRT
+    # client and wedge the tunnel. Must run before any backend init. No-op
+    # when the platform is forced to CPU.
     from tpu_dist.comm import tpu_lock
 
-    tpu_lock.guard_or_exit("bench")
+    tpu_lock.guard_or_exit("bench", wait_s=args.lock_wait)
 
     # persistent XLA compile cache: repeat bench invocations skip the
     # ~20-40s first-compile cost

@@ -44,12 +44,13 @@ def lock_path(tmp_path):
 
 @pytest.fixture(autouse=True)
 def _clear_held():
-    # isolate the process-local reentrancy state between tests
+    # isolate the process-local reentrancy state between tests (force:
+    # drop the flock regardless of leftover refcounts)
     for lock in list(tpu_lock._held.values()):
-        lock.release()
+        lock.release(force=True)
     yield
     for lock in list(tpu_lock._held.values()):
-        lock.release()
+        lock.release(force=True)
 
 
 def test_cpu_forced_is_noop(lock_path):
@@ -74,6 +75,78 @@ def test_reentrant_same_process(lock_path):
     b = tpu_lock.acquire(owner="bench", path=lock_path, force_cpu_ok=False)
     assert b is a  # second acquire in the same process: same handle
     a.release()
+    a.release()  # balanced: one per acquire
+
+
+def test_nested_release_keeps_outer_claim(lock_path):
+    """ADVICE r3 (medium): a nested claimant (Trainer inside bench.py) whose
+    construction fails releases only ITS claim — the outer holder keeps the
+    machine-wide lock, so a contender process is still refused."""
+    outer = tpu_lock.acquire(owner="bench", path=lock_path, force_cpu_ok=False)
+    inner = tpu_lock.acquire(owner="trainer", path=lock_path, force_cpu_ok=False)
+    inner.release()  # the failed-Trainer path
+    assert not outer._released
+    # a second process must STILL be refused: the flock is held
+    rc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import sys\n"
+            "from tpu_dist.comm import tpu_lock\n"
+            "tpu_lock.acquire(owner='x', path=sys.argv[1], force_cpu_ok=False)\n",
+            lock_path,
+        ],
+        cwd="/root/repo",
+        capture_output=True,
+        text=True,
+    )
+    assert rc.returncode != 0 and "TPULockError" in rc.stderr
+    outer.release()  # last claim out: flock drops
+    assert outer._released
+    rc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import sys\n"
+            "from tpu_dist.comm import tpu_lock\n"
+            "assert tpu_lock.acquire(owner='x', path=sys.argv[1], force_cpu_ok=False)\n",
+            lock_path,
+        ],
+        cwd="/root/repo",
+        capture_output=True,
+        text=True,
+    )
+    assert rc.returncode == 0, rc.stderr
+
+
+def test_wait_s_acquires_once_holder_exits(lock_path):
+    """The round-3 driver-bench failure: landing mid-probe must wait the
+    bounded holder out, not refuse instantly."""
+    holder = _spawn_holder(lock_path, hold_s=1.5)
+    try:
+        t0 = time.monotonic()
+        lock = tpu_lock.acquire(
+            owner="bench", path=lock_path, force_cpu_ok=False, wait_s=30
+        )
+        assert lock is not None
+        assert time.monotonic() - t0 < 29  # won as soon as the holder died
+        lock.release()
+    finally:
+        holder.kill()
+        holder.wait()
+
+
+def test_wait_s_deadline_still_refuses(lock_path):
+    holder = _spawn_holder(lock_path, hold_s=60)
+    try:
+        with pytest.raises(tpu_lock.TPULockError) as ei:
+            tpu_lock.acquire(
+                owner="bench", path=lock_path, force_cpu_ok=False, wait_s=1
+            )
+        assert "waited 1s" in str(ei.value)
+    finally:
+        holder.kill()
+        holder.wait()
 
 
 def test_live_holder_refused_with_clear_message(lock_path):

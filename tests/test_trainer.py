@@ -73,3 +73,23 @@ def test_config_argparse_bridge():
     assert cfg.seed == 3
     # reference-compat flags accepted silently
     p.parse_args(["--local_rank", "2", "--gpu", "0,1"])
+
+
+def test_adamw_decay_mask_resume_guard(tmp_path):
+    """ADVICE r3: the opt-state shapes are mask-independent, so a resume
+    under a different decay mask must be refused loudly, not silently
+    change the update math mid-run."""
+    import pytest
+
+    cfg = _cfg(
+        optimizer="adamw", ckpt_dir=str(tmp_path), save_every=1, epochs=1
+    )
+    Trainer(cfg).fit()
+
+    # same mask: resumes fine
+    t2 = Trainer(cfg.replace(resume=True, epochs=2))
+    assert t2.start_epoch == 1
+
+    # flipped mask: refused with guidance naming the trained-with mask
+    with pytest.raises(ValueError, match="adamw_decay_mask"):
+        Trainer(cfg.replace(resume=True, epochs=2, adamw_decay_mask="all"))

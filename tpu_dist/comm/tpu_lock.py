@@ -89,14 +89,24 @@ class TPULock:
         self.pid = os.getpid()
         self._fd = fd
         self._released = False
+        # Reentrancy refcount (ADVICE r3, medium): acquire() hands the SAME
+        # handle to nested claimants (bench.py -> Trainer -> probe). Each
+        # balanced release() only decrements; the flock drops at zero. A
+        # Trainer whose construction fails therefore gives back only ITS
+        # claim — the outer holder keeps the machine-wide lock.
+        self._refs = 1
 
-    def release(self) -> None:
+    def release(self, force: bool = False) -> None:
         # fork guard: a child inheriting this handle via atexit must not
         # act on the parent's lock (closing the child's fd copy would not
         # drop the flock anyway — it rides the shared open file
         # description — but keep the state bookkeeping parent-only)
         if self._released or os.getpid() != self.pid:
             return
+        if not force:
+            self._refs -= 1
+            if self._refs > 0:
+                return
         self._released = True
         if _held.get(self.path) is self:
             del _held[self.path]
@@ -119,17 +129,46 @@ class TPULock:
         self.release()
 
 
+def _read_holder(fd: int) -> tuple:
+    """Best-effort pid/owner of the current flock holder, for messages.
+    Content is advisory, the flock is the truth; a fresh winner may not
+    have written its pid yet, so re-read once after a beat to avoid naming
+    the PREVIOUS (dead) holder."""
+    import time as _time
+
+    try:
+        data = os.pread(fd, 256, 0).decode(errors="replace").splitlines()
+        _time.sleep(0.05)
+        data2 = os.pread(fd, 256, 0).decode(errors="replace").splitlines()
+        if data2:
+            data = data2
+    except OSError:
+        data = []
+    pid = data[0] if data else "?"
+    owner = data[1] if len(data) > 1 else "?"
+    return pid, owner
+
+
 def acquire(
     owner: str = "tpu_dist",
     path: Optional[str] = None,
     force_cpu_ok: bool = True,
+    wait_s: float = 0.0,
 ) -> Optional[TPULock]:
     """Acquire the machine-wide TPU lock, or raise :class:`TPULockError`.
 
     Returns ``None`` (no-op) when this process is unambiguously CPU-only
     and ``force_cpu_ok`` — CPU test runs must not contend. Re-acquiring the
     same path in a process that already holds it returns the existing
-    handle.
+    handle with its refcount bumped — each claimant must :meth:`release
+    <TPULock.release>` exactly once; the flock drops when the last one does.
+
+    ``wait_s > 0``: on contention, keep retrying (2 s poll) until the
+    holder exits or the deadline passes, instead of refusing immediately.
+    This is how the driver's end-of-round ``bench.py`` survives landing in
+    the middle of a bounded probe (round 3: rc=4 because a watcher probe
+    held the lock at that instant) — the probe exits within its own
+    timeout, the waiter then wins the flock.
     """
     if path is None:
         path = DEFAULT_LOCK_PATH  # resolved at call time (testable)
@@ -140,6 +179,7 @@ def acquire(
         return None
     existing = _held.get(path)
     if existing is not None and not existing._released:
+        existing._refs += 1
         return existing
 
     try:
@@ -151,53 +191,76 @@ def acquire(
             f"cannot open TPU lock {path}: {e}. If another user's run "
             "created it, coordinate or choose a different lock path."
         )
-    try:
-        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-    except OSError as e:
-        import errno as _errno
+    import errno as _errno
+    import time as _time
 
-        held_by_other = e.errno in (_errno.EWOULDBLOCK, _errno.EAGAIN, _errno.EACCES)
-        # locked by a live process: read its pid/owner for the message.
-        # Best-effort — content is advisory, the flock is the truth; a
-        # fresh winner may not have written its pid yet, so re-read once
-        # after a beat to avoid naming the PREVIOUS (dead) holder.
-        import time as _time
-
+    deadline = _time.monotonic() + max(0.0, wait_s)
+    announced = False
+    while True:
         try:
-            data = os.pread(fd, 256, 0).decode(errors="replace").splitlines()
-            _time.sleep(0.05)
-            data2 = os.pread(fd, 256, 0).decode(errors="replace").splitlines()
-            if data2:
-                data = data2
-        except OSError:
-            data = []
-        finally:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            break
+        except OSError as e:
+            contention = e.errno in (_errno.EWOULDBLOCK, _errno.EAGAIN)
+            # EACCES from flock is ambiguous (ADVICE r3): some kernels/
+            # filesystems use it for contention, others for a locking-
+            # infrastructure or permissions problem. Treat it as possibly
+            # held, but say both in the message.
+            maybe_held = contention or e.errno == _errno.EACCES
+            if not maybe_held:  # ENOLCK etc.: infrastructure, not a holder
+                os.close(fd)
+                raise TPULockError(f"flock on TPU lock {path} failed: {e}")
+            remaining = deadline - _time.monotonic()
+            if remaining > 0:
+                if not announced:
+                    pid, own = _read_holder(fd)
+                    print(
+                        f"{owner}: TPU lock {path} held by pid {pid} "
+                        f"(owner: {own}); waiting up to {wait_s:.0f}s for "
+                        "it to finish...",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                    announced = True
+                _time.sleep(min(2.0, remaining))
+                continue
+            pid, own = _read_holder(fd)
             os.close(fd)
-        if not held_by_other:  # ENOLCK etc.: infrastructure, not a holder
-            raise TPULockError(f"flock on TPU lock {path} failed: {e}")
-        holder_pid = data[0] if data else "?"
-        holder_owner = data[1] if len(data) > 1 else "?"
-        raise TPULockError(
-            f"TPU is held by live process {holder_pid} "
-            f"(owner: {holder_owner}, lock: {path}). Refusing to "
-            "start a second TPU client — concurrent clients wedge "
-            "the tunnel for the rest of the session. Wait for it "
-            "to finish, or kill it and retry."
-        )
+            waited = f" (waited {wait_s:.0f}s)" if wait_s > 0 else ""
+            if contention:
+                raise TPULockError(
+                    f"TPU is held by live process {pid} "
+                    f"(owner: {own}, lock: {path}){waited}. Refusing to "
+                    "start a second TPU client — concurrent clients wedge "
+                    "the tunnel for the rest of the session. Wait for it "
+                    "to finish, or kill it and retry."
+                )
+            raise TPULockError(
+                f"flock on TPU lock {path} failed with EACCES{waited}. "
+                f"Either a live process holds it (last recorded holder: "
+                f"pid {pid}, owner {own}) or this filesystem/permission "
+                "setup cannot take the lock — check for a holder first; "
+                "if none exists, check lockfile ownership/permissions or "
+                "choose a different lock path."
+            )
     # we hold it: record pid/owner for contenders' error messages
     os.ftruncate(fd, 0)
     os.pwrite(fd, f"{os.getpid()}\n{owner}\n".encode(), 0)
     lock = TPULock(path, owner, fd)
     _held[path] = lock
-    atexit.register(lock.release)
+    # exit safety net, not a balanced release: drop the flock no matter
+    # how many claimants never released (the kernel would anyway)
+    atexit.register(lock.release, force=True)
     return lock
 
 
-def guard_or_exit(owner: str, exit_code: int = 4) -> Optional[TPULock]:
+def guard_or_exit(
+    owner: str, exit_code: int = 4, wait_s: float = 0.0
+) -> Optional[TPULock]:
     """CLI-entrypoint wrapper: acquire or print the holder message to stderr
     and exit with ``exit_code`` (distinct from bench's 3 = tunnel timeout)."""
     try:
-        return acquire(owner)
+        return acquire(owner, wait_s=wait_s)
     except TPULockError as e:
         print(f"{owner}: {e}", file=sys.stderr, flush=True)
         raise SystemExit(exit_code)

@@ -99,6 +99,9 @@ class Trainer:
         # lock BEFORE the first backend touch below; no-op on CPU configs.
         # Released on a failed construction (e.g. a config-validation raise)
         # so a caught ValueError doesn't hold the TPU for the process life.
+        # acquire() refcounts reentrant claims (ADVICE r3), so this release
+        # gives back only the Trainer's claim — an outer holder (bench.py,
+        # __graft_entry__) keeps the machine-wide lock.
         from tpu_dist.comm import tpu_lock  # noqa: PLC0415
 
         self._tpu_lock = tpu_lock.acquire(owner="trainer")
@@ -686,9 +689,14 @@ class Trainer:
         storage permutes block order on disk (vit_pp device-major layout), so
         a ckpt is only loadable under the SAME pp/pp_interleave — the tag
         lets resume refuse a mismatch instead of silently training with
-        permuted blocks."""
+        permuted blocks. AdamW additionally stamps its decay mask (ADVICE
+        r3): the opt-state SHAPES are mask-independent, so a resume under a
+        different mask would succeed and silently change the update math."""
         cfg = self.cfg
-        return {"pp": cfg.pp, "pp_interleave": cfg.pp_interleave}
+        meta = {"pp": cfg.pp, "pp_interleave": cfg.pp_interleave}
+        if cfg.optimizer == "adamw":
+            meta["adamw_decay_mask"] = cfg.adamw_decay_mask
+        return meta
 
     def _check_ckpt_layout(self, path: str) -> None:
         cfg = self.cfg
@@ -714,6 +722,28 @@ class Trainer:
                 f"layout-specific; resume with the same flags (got "
                 f"pp={cfg.pp}, pp_interleave={cfg.pp_interleave})"
             )
+        if cfg.optimizer == "adamw":
+            ck_mask = meta.get("adamw_decay_mask")
+            if ck_mask is None:
+                # pre-stamp AdamW checkpoint: can't know which mask trained
+                # it — warn rather than block (resume stays possible, but
+                # the operator is told the math may shift)
+                rank0_print(
+                    f"WARNING: checkpoint {path} predates the "
+                    "adamw_decay_mask stamp; resuming with "
+                    f"--adamw_decay_mask {cfg.adamw_decay_mask} — if the "
+                    "run was trained with a different mask, weight decay "
+                    "on bias/norm leaves silently changes from here on"
+                )
+            elif ck_mask != cfg.adamw_decay_mask:
+                raise ValueError(
+                    f"checkpoint {path} was trained with adamw_decay_mask="
+                    f"{ck_mask!r} but this run uses "
+                    f"{cfg.adamw_decay_mask!r} — the opt-state shapes are "
+                    "identical, so resuming would silently change which "
+                    "leaves get weight decay mid-training; pass "
+                    f"--adamw_decay_mask {ck_mask} to resume faithfully"
+                )
 
     def _check_mesh_host_layout(self) -> None:
         """Refuse multi-host meshes whose model axes cross hosts: TP/EP/PP
