@@ -98,6 +98,7 @@ class BenchConfig:
     sync_bn: bool = True
     fused_epoch: bool = False  # device-resident data, one jit per epoch
     flash: bool = False        # Pallas tiled attention (transformer models)
+    s2d: bool = False          # space-to-depth stem (ImageNet ResNet only)
     epoch_images: int = CIFAR_TRAIN  # for sec/epoch derivation
 
 
@@ -108,9 +109,17 @@ CONFIGS = {
         BenchConfig("resnet18_cifar100_fp32", "resnet18", 32, 100, 256, bf16=False),
         BenchConfig("resnet18_cifar100_ga4", "resnet18", 32, 100, 256, grad_accum=4),
         BenchConfig("resnet18_cifar100_fused", "resnet18", 32, 100, 256, fused_epoch=True),
+        # b128: the measured single-chip operating point (BENCH_NOTES r2
+        # batch sweep: b64 2,430 img/s / MFU 0.296 vs b128 2,624 / 0.319)
         BenchConfig(
-            "resnet50_imagenet", "resnet50_imagenet", 224, 1000, 64,
+            "resnet50_imagenet", "resnet50_imagenet", 224, 1000, 128,
             epoch_images=1_281_167,
+        ),
+        # same model, space-to-depth stem (MXU-utilization rewrite of the
+        # 7x7/2 C_in=3 conv; numerics-identical, nn/resnet.py::_stem_s2d)
+        BenchConfig(
+            "resnet50_imagenet_s2d", "resnet50_imagenet", 224, 1000, 128,
+            s2d=True, epoch_images=1_281_167,
         ),
         BenchConfig(
             "vit_b16_imagenet", "vit_b16", 224, 1000, 64,
@@ -138,7 +147,9 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None)
 
     models = {
         "resnet18": resnet18, "resnet34": resnet34, "resnet50": resnet50,
-        "resnet50_imagenet": resnet50_imagenet,
+        "resnet50_imagenet": lambda num_classes: resnet50_imagenet(
+            num_classes, s2d_stem=cfg.s2d
+        ),
         "vit_b16": lambda num_classes: vit_b16(num_classes, cfg.image_size),
     }
     from tpu_dist.nn.attention import set_default_attention_impl
@@ -270,6 +281,81 @@ def _run_fused(cfg: BenchConfig, mesh, model, optimizer, state, n_dev: int, batc
         "global_batch": batch,
         "img_per_sec_per_chip": round(img_per_sec / n_dev, 1),
         "mfu": _mfu(flops_per_epoch, dt, n_dev),
+    }
+
+
+def run_attn(seq_len: int, steps: int, warmup: int, *, batch: int = 0,
+             causal: bool = False) -> dict:
+    """Long-sequence attention micro-bench: Pallas flash kernel vs the XLA
+    [S,S]-materializing path, fwd+bwd, one JSON line.
+
+    The reference has no attention at all (SURVEY §2.3); this is the
+    long-context showcase for ``ops/flash_attention.py`` — at lengths where
+    the XLA path's [B·H, S, S] f32 score tensor stops fitting in HBM
+    (S=16k at these shapes wants ~17 GB for the scores alone on a 16 GB
+    chip), flash keeps O(block²) per-core working sets. ``vs_baseline``
+    here = flash speedup over the XLA path (>1 means the kernel wins;
+    null when XLA could not run at all — the strongest possible win).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.nn.attention import full_attention
+
+    heads, d_head = 8, 128  # model dim 1024, MXU-native 128-lane head dim
+    if batch <= 0:
+        batch = max(1, 32_768 // seq_len)  # ~32k tokens per step
+    shape = (batch, seq_len, heads, d_head)
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+
+    def bench_impl(impl: str):
+        def loss(q, k, v):
+            out = full_attention(q, k, v, causal=causal, impl=impl)
+            return out.astype(jnp.float32).sum()
+
+        step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        try:
+            call = step.lower(q, k, v).compile()
+            for _ in range(warmup):
+                jax.block_until_ready(call(q, k, v))
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = call(q, k, v)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / steps, None
+        except Exception as e:  # RESOURCE_EXHAUSTED at S=16k is the point
+            return None, f"{type(e).__name__}: {str(e).splitlines()[0][:160]}"
+
+    flash_s, flash_err = bench_impl("flash")
+    xla_s, xla_err = bench_impl("xla")
+
+    # analytic fwd+bwd FLOPs (QK^T + PV fwd = 4·S²·D/head; FA2 bwd ≈ 2.5×):
+    # XLA cost analysis can't see inside pallas_call, so both impls use the
+    # same formula — MFU comparable across the two columns
+    flops = 14.0 * batch * heads * seq_len * seq_len * d_head
+    if causal:
+        flops /= 2
+    tok_per_sec = round(batch * seq_len / flash_s, 1) if flash_s else None
+    return {
+        "metric": f"attn_s{seq_len}{'_causal' if causal else ''}_flash_fwd_bwd",
+        "value": tok_per_sec,
+        "unit": "tokens/sec",
+        "vs_baseline": (
+            round(xla_s / flash_s, 3) if flash_s and xla_s else None
+        ),
+        "seq_len": seq_len,
+        "batch": batch,
+        "heads": heads,
+        "head_dim": d_head,
+        "flash_ms": round(1000 * flash_s, 2) if flash_s else None,
+        "xla_ms": round(1000 * xla_s, 2) if xla_s else None,
+        "flash_err": flash_err,
+        "xla_err": xla_err,
+        "mfu": _mfu(flops, flash_s, 1) if flash_s else None,
+        "xla_mfu": _mfu(flops, xla_s, 1) if xla_s else None,
     }
 
 
@@ -459,6 +545,21 @@ def main() -> None:
              "shrinks the bubble (S-1)/(vM+S-1)",
     )
     p.add_argument(
+        "--attn", type=int, default=0, metavar="S",
+        help="long-sequence attention micro-bench at sequence length S: "
+             "Pallas flash kernel vs the XLA path, fwd+bwd (the "
+             "long-context showcase; try 1024/4096/16384)",
+    )
+    p.add_argument(
+        "--attn_all", action="store_true",
+        help="run the attention micro-bench at S=1024, 4096, 16384 "
+             "(one line each)",
+    )
+    p.add_argument("--attn_batch", type=int, default=0,
+                   help="batch for --attn (0 = ~32k tokens/step)")
+    p.add_argument("--causal", action="store_true",
+                   help="causal masking for --attn")
+    p.add_argument(
         "--scaling", action="store_true",
         help="run the config on 1,2,4,...,N-device meshes and report "
              "scaling efficiency (BASELINE's 1→8→32 chip metric; limited "
@@ -490,6 +591,14 @@ def main() -> None:
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
 
     _guarded_backend_init(args.init_timeout)
+    if args.attn or args.attn_all:
+        lengths = (1024, 4096, 16384) if args.attn_all else (args.attn,)
+        for s in lengths:
+            print(json.dumps(run_attn(
+                s, args.steps, args.warmup,
+                batch=args.attn_batch, causal=args.causal,
+            )), flush=True)
+        return
     if args.pp:
         cfg_name = args.config if args.config.startswith("vit") else "vit_b16_imagenet"
         print(json.dumps(run_pp(

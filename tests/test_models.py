@@ -7,6 +7,7 @@ counts minus the ``num_batches_tracked`` scalars torch adds per BN layer.
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from tpu_dist.nn import resnet18, resnet34, resnet50
@@ -63,3 +64,29 @@ def test_eval_uses_running_stats():
     assert e1.shape == e2.shape
     t1, _ = m.apply(params, state, x, train=True)
     assert not jnp.allclose(e1, t1)  # train normalizes by batch stats
+
+
+def test_s2d_stem_matches_plain_stem():
+    """The space-to-depth stem is the SAME function as the 7x7/2 conv
+    (MXU-utilization rewrite, nn/resnet.py::_stem_s2d) — same params, same
+    logits up to f32 summation order. A narrow bottleneck net keeps the
+    check fast; the stem kernel is full-size 7x7 either way."""
+    import dataclasses
+
+    from tpu_dist.nn.resnet import ResNetDef
+
+    plain = ResNetDef(
+        "bottleneck", (1, 1, 1, 1), num_classes=11,
+        widths=(8, 8, 16, 16), imagenet_stem=True,
+    )
+    s2d = dataclasses.replace(plain, s2d_stem=True)
+    params, state = plain.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64, 3))
+
+    ref, _ = plain.apply(params, state, x, train=False)
+    got, _ = s2d.apply(params, state, x, train=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    # odd spatial input is refused, not silently mis-shaped
+    with pytest.raises(ValueError, match="even"):
+        s2d.apply(params, state, x[:, :63, :, :], train=False)

@@ -34,6 +34,62 @@ def synthetic_imagenet(
     return synthetic_cifar(n, num_classes, image_size, seed)
 
 
+def synthetic_multifactor(
+    n: int = 10_000,
+    image_size: int = 32,
+    seed: int = 0,
+    label_noise: float = 0.1,
+    amp: float = 0.35,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """DISCRIMINATING convergence task (VERDICT r2 #4): 16 classes from two
+    independent factors, plus label noise — built so a run can't memorize
+    it in one epoch and flatline (the failure mode of the quadrant task).
+
+    * factor 1 (position): a faint +``amp``·σ blob in one of 4 quadrants;
+    * factor 2 (texture): a faint sinusoidal stripe pattern — one of 2
+      orientations × 2 spatial frequencies — the conv stack must learn
+      oriented frequency filters, not just mean pooling;
+    * class = 4·f1 + f2 (chance = 6.25%);
+    * ``label_noise`` of the TRAIN labels are resampled uniformly, so
+      (a) 100% train accuracy is impossible without gross overfitting and
+      (b) optimization dynamics matter: a constant high LR keeps bouncing
+      off the noise floor, while the reference's MultiStepLR decay
+      (distributed.py:64 semantics) settles — the convergence test asserts
+      this gap, making the LR schedule *visibly* load-bearing.
+
+    Signals sit at ``amp`` (default 0.35) of the background σ ≈ 32 grey
+    levels, i.e. ~11 levels — learnable, but only over many epochs.
+    Evaluation splits should pass ``label_noise=0`` so val accuracy
+    measures the true function.
+    """
+    rng = np.random.default_rng(seed)
+    h = image_size
+    half = h // 2
+    x = rng.normal(0.0, 1.0, size=(n, h, h, 3)).astype(np.float32)
+    f1 = rng.integers(0, 4, n)
+    f2 = rng.integers(0, 4, n)
+    for quad in range(4):
+        idx = np.where(f1 == quad)[0]
+        r, c = divmod(quad, 2)
+        x[idx, r * half : (r + 1) * half, c * half : (c + 1) * half, :] += amp
+    yy, xx = np.meshgrid(np.arange(h), np.arange(h), indexing="ij")
+    stripes = [
+        np.sin(2 * np.pi * 2 * xx / h),
+        np.sin(2 * np.pi * 2 * yy / h),
+        np.sin(2 * np.pi * 5 * xx / h),
+        np.sin(2 * np.pi * 5 * yy / h),
+    ]
+    for v in range(4):
+        idx = np.where(f2 == v)[0]
+        x[idx] += amp * stripes[v][None, :, :, None].astype(np.float32)
+    labels = (4 * f1 + f2).astype(np.int32)
+    if label_noise > 0:
+        flip = rng.random(n) < label_noise
+        labels[flip] = rng.integers(0, 16, int(flip.sum())).astype(np.int32)
+    images = np.clip(128.0 + 32.0 * x, 0, 255).astype(np.uint8)
+    return images, labels
+
+
 def synthetic_quadrant(
     n: int = 10_000,
     image_size: int = 32,

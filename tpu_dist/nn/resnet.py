@@ -40,6 +40,15 @@ class ResNetDef:
     # (utils/model.py:66-70). imagenet_stem=True switches to the canonical
     # 7x7/stride-2 stem + 3x3/stride-2 maxpool for 224x224 inputs.
     imagenet_stem: bool = False
+    # MXU-friendly stem (TPU-only concern, MLPerf-style): compute the
+    # 7x7/2 stem as a mathematically-identical 4x4/1 conv on the 2x2
+    # space-to-depth transform of the input. C_in=3 leaves 125 of the
+    # MXU's 128 input lanes idle for the heaviest-spatial conv of the
+    # net; s2d quadruples arithmetic intensity (C_in 3→12, spatial /4)
+    # without changing parameters, checkpoints, or numerics (bit-exact
+    # up to f32 summation order — see tests/test_models.py). Only
+    # meaningful with imagenet_stem; requires even H, W.
+    s2d_stem: bool = False
 
     @property
     def expansion(self) -> int:
@@ -118,7 +127,10 @@ class ResNetDef:
         new_state = {}
 
         if self.imagenet_stem:
-            y = L.conv_apply(params["stem_conv"], x, stride=2, padding=3)
+            if self.s2d_stem:
+                y = self._stem_s2d(params["stem_conv"]["w"], x)
+            else:
+                y = L.conv_apply(params["stem_conv"], x, stride=2, padding=3)
         else:
             y = L.conv_apply(params["stem_conv"], x, stride=1, padding=1)
         y, new_state["stem_bn"] = L.bn_apply(params["stem_bn"], state["stem_bn"], y, **bn)
@@ -141,6 +153,46 @@ class ResNetDef:
         y = L.global_avg_pool(y)
         logits = L.linear_apply(params["fc"], y)
         return logits, new_state
+
+    @staticmethod
+    def _stem_s2d(w, x):
+        """7x7/stride-2 stem conv, computed as an equivalent 4x4/stride-1
+        conv over the 2x2 space-to-depth rearrangement of the input.
+
+        Identity: pad the kernel to 8x8 with a zero top row/left column,
+        so ``y[i,j] = Σ_{a,b∈[0,8)} W8[a,b]·x[2i+a-4, 2j+b-4]``; split
+        ``a = 2p+u`` (phase u over the s2d factor) and the sum factorizes
+        into a 4x4 conv over ``X[m,n,(u,v,c)] = x[2m+u, 2n+v, c]`` with
+        asymmetric padding (2,1). Parameters stay stored as the plain
+        [7,7,3,C] kernel — checkpoints are interchangeable between the
+        two stems; the rearrangement is ~9k elements at trace time.
+        """
+        from jax import lax as _lax  # noqa: PLC0415
+
+        k, _, c_in, c_out = w.shape
+        if k != 7:
+            raise ValueError(f"s2d stem expects the 7x7 kernel, got {k}x{k}")
+        w8 = jnp.pad(w, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        w4 = (
+            w8.reshape(4, 2, 4, 2, c_in, c_out)
+            .transpose(0, 2, 1, 3, 4, 5)
+            .reshape(4, 4, 4 * c_in, c_out)
+        )
+        n, h, wd, c = x.shape
+        if h % 2 or wd % 2:
+            raise ValueError(f"s2d stem needs even H, W; got {h}x{wd}")
+        xs = (
+            x.reshape(n, h // 2, 2, wd // 2, 2, c)
+            .transpose(0, 1, 3, 2, 4, 5)
+            .reshape(n, h // 2, wd // 2, 4 * c)
+        )
+        return _lax.conv_general_dilated(
+            xs,
+            w4.astype(xs.dtype),
+            window_strides=(1, 1),
+            padding=[(2, 1), (2, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
 
     def _block_apply(self, p, s, x, stride, bn):
         ns = {}
@@ -183,7 +235,12 @@ def resnet50(num_classes: int = 100) -> ResNetDef:
     return ResNetDef("bottleneck", (3, 4, 6, 3), num_classes)
 
 
-def resnet50_imagenet(num_classes: int = 1000) -> ResNetDef:
+def resnet50_imagenet(num_classes: int = 1000, s2d_stem: bool = False) -> ResNetDef:
     """Canonical ImageNet ResNet-50 (7x7 stem + maxpool; ~25.6M params) —
-    for the BASELINE ResNet-50/ImageNet-1k config."""
-    return ResNetDef("bottleneck", (3, 4, 6, 3), num_classes, imagenet_stem=True)
+    for the BASELINE ResNet-50/ImageNet-1k config. ``s2d_stem=True``
+    computes the identical stem via space-to-depth (TPU MXU utilization;
+    same params/checkpoints)."""
+    return ResNetDef(
+        "bottleneck", (3, 4, 6, 3), num_classes,
+        imagenet_stem=True, s2d_stem=s2d_stem,
+    )

@@ -390,6 +390,15 @@ class Trainer:
             self.test_data = synthetic_quadrant(
                 max(cfg.synthetic_n // 5, self.n_devices), seed=2
             )
+        elif cfg.dataset == "synthetic_multifactor":
+            from tpu_dist.data.synthetic import synthetic_multifactor  # noqa: PLC0415
+
+            # train labels carry the task's noise; eval labels are clean so
+            # val accuracy measures the true function (data/synthetic.py)
+            self.train_data = synthetic_multifactor(cfg.synthetic_n, seed=1)
+            self.test_data = synthetic_multifactor(
+                max(cfg.synthetic_n // 5, self.n_devices), seed=2, label_noise=0.0
+            )
         elif cfg.dataset == "cifar100":
             self.train_data = load_cifar100(cfg.data_dir, train=True)
             self.test_data = load_cifar100(cfg.data_dir, train=False)
@@ -398,7 +407,10 @@ class Trainer:
             self.test_data = load_cifar10(cfg.data_dir, train=False)
         else:
             raise ValueError(f"unknown dataset {cfg.dataset!r}")
-        _DATASET_CLASSES = {"cifar100": 100, "cifar10": 10, "synthetic_learnable": 4}
+        _DATASET_CLASSES = {
+            "cifar100": 100, "cifar10": 10,
+            "synthetic_learnable": 4, "synthetic_multifactor": 16,
+        }
         expected = _DATASET_CLASSES.get(cfg.dataset)
         if expected is not None and cfg.num_classes != expected:
             raise ValueError(
