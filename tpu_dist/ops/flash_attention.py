@@ -18,11 +18,13 @@ on the CUDA side — built here the TPU way: ``pl.pallas_call`` over a
 (batch·heads, S/block_q, S/block_k) grid, f32 accumulation in VMEM
 scratch, sequential innermost grid dimension carrying the softmax state.
 
-Backward: a ``jax.custom_vjp`` that recomputes probabilities blockwise
-from the saved (m, l) statistics in a ``lax.scan`` over K/V blocks —
-O(S·block) memory, the FlashAttention-2 dq/dk/dv recipe — expressed at
-the XLA level where the compiler fuses the elementwise chain into the
-matmuls.
+Backward: a ``jax.custom_vjp`` running the FlashAttention-2 dq/dk/dv
+recipe as two tiled Pallas kernels (default ``bwd='pallas'``): a dK/dV
+pass gridded over k-blocks accumulating across q-blocks in VMEM scratch,
+and a dQ pass gridded the other way — probabilities recomputed blockwise
+from the saved (m, l) statistics, O(block²) working set, never
+materializing [S, S]. The original XLA-level ``lax.scan`` formulation is
+kept behind ``bwd='xla'`` for A/B comparison and as a fallback.
 
 Works on any backend via Pallas interpret mode (auto-selected off-TPU),
 which is how the CPU test suite checks it bit-for-bit against the XLA
@@ -160,6 +162,209 @@ def _fwd(q3, k3, v3, causal, block_q, block_k, interpret):
     return out[:, :s_q], m[:, :s_q], l[:, :s_q]
 
 
+def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, delta_ref,
+                    i, j, *, scale, causal, block_q, block_k, q_len, kv_len):
+    """Shared backward block math: recompute the probability block ``p``
+    and the score-gradient block ``ds`` from the saved (m, l) statistics.
+    One definition, used by BOTH backward kernels — the masking and the
+    renormalization clamp must never desync between the dq and dk/dv
+    passes. Returns f32 ``(q, do, p, ds)`` blocks."""
+    q = q_ref[0].astype(jnp.float32)                       # [bq, d]
+    do = do_ref[0].astype(jnp.float32)                     # [bq, d]
+    k = k_ref[0].astype(jnp.float32)                       # [bk, d]
+    v = v_ref[0].astype(jnp.float32)                       # [bk, d]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale                                              # [bq, bk]
+
+    q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    # padded q rows carry zero m/l from _pad_to — mask them out explicitly
+    mask = jnp.logical_and(q_pos < q_len, k_pos < kv_len)
+    if causal:
+        mask = jnp.logical_and(mask, q_pos >= k_pos)
+
+    m_i = m_ref[0][:, None]                                # [bq, 1]
+    l_i = jnp.maximum(l_ref[0][:, None], 1e-30)
+    p = jnp.where(mask, jnp.exp(s - m_i), 0.0) / l_i       # [bq, bk]
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )                                                      # [bq, bk]
+    ds = p * (dp - delta_ref[0][:, None]) * scale
+    return q, do, p, ds
+
+
+def _causal_block_live(i, j, block_q, block_k):
+    """False iff the (q-block i, k-block j) tile lies entirely above the
+    causal diagonal (max q_pos < min k_pos) — those tiles are all-masked,
+    so both backward kernels skip their matmuls (~2× fewer FLOPs at long
+    S; the accumulators simply don't change)."""
+    return (i + 1) * block_q - 1 >= j * block_k
+
+
+def _bwd_dkdv_kernel(q_ref, do_ref, m_ref, l_ref, delta_ref, k_ref, v_ref,
+                     dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                     block_q, block_k, q_len, kv_len, k_dtype, v_dtype):
+    """dK/dV pass (FlashAttention-2): one (batch·head, k-block) per grid
+    point, accumulating over q-blocks in VMEM scratch — the innermost grid
+    dim is the q loop, declared ``arbitrary`` so only it is sequential."""
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _accumulate():
+        q, do, p, ds = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, delta_ref, i, j,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            q_len=q_len, kv_len=kv_len,
+        )
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )                                                  # p^T do: [bk, d]
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )                                                  # ds^T q: [bk, d]
+
+    if causal:
+        pl.when(_causal_block_live(i, j, block_q, block_k))(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(i == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(k_dtype)
+        dv_ref[0] = dv_scr[:].astype(v_dtype)
+
+
+def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, m_ref, l_ref, delta_ref,
+                   dq_ref, dq_scr, *, scale, causal, block_q, block_k,
+                   q_len, kv_len, out_dtype):
+    """dQ pass: one (batch·head, q-block) per grid point, accumulating over
+    k-blocks (innermost, sequential) in VMEM scratch."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _accumulate():
+        _, _, _, ds = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, delta_ref, i, j,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            q_len=q_len, kv_len=kv_len,
+        )
+        k = k_ref[0].astype(jnp.float32)
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        pl.when(_causal_block_live(i, j, block_q, block_k))(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(j == n_k - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(out_dtype)
+
+
+def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret):
+    """Pallas FlashAttention-2 backward: two tiled passes (dK/dV then dQ),
+    O(block²) VMEM working set, never materializing [S, S] — the TPU-kernel
+    sibling of the XLA-level ``_bwd_blocked`` (kept for A/B and as the
+    ``bwd='xla'`` escape hatch)."""
+    bh, s_q, d = q3.shape
+    s_kv = k3.shape[1]
+    bq = min(block_q, -(-s_q // 8) * 8)
+    bk = min(block_k, -(-s_kv // 8) * 8)
+    scale = 1.0 / float(d) ** 0.5
+
+    delta = jnp.sum(
+        do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1
+    )                                                      # [BH, S]
+    qp = _pad_to(q3, bq, 1)
+    dop = _pad_to(do3, bq, 1)
+    mp = _pad_to(m, bq, 1)
+    lp = _pad_to(l, bq, 1)
+    deltap = _pad_to(delta, bq, 1)
+    kp = _pad_to(k3, bk, 1)
+    vp = _pad_to(v3, bk, 1)
+    n_q = qp.shape[1] // bq
+    n_k = kp.shape[1] // bk
+    mem = {"memory_space": pltpu.VMEM}
+
+    q_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0), **mem),  # q
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0), **mem),  # do
+        pl.BlockSpec((1, bq), lambda b, j, i: (b, i), **mem),        # m
+        pl.BlockSpec((1, bq), lambda b, j, i: (b, i), **mem),        # l
+        pl.BlockSpec((1, bq), lambda b, j, i: (b, i), **mem),        # delta
+    ]
+    kv_specs = [
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), **mem),  # k
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), **mem),  # v
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkdv_kernel, scale=scale, causal=causal, block_q=bq,
+            block_k=bk, q_len=s_q, kv_len=s_kv,
+            k_dtype=k3.dtype, v_dtype=v3.dtype,
+        ),
+        grid=(bh, n_k, n_q),
+        in_specs=q_specs + kv_specs,
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), **mem),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), **mem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(kp.shape, k3.dtype),
+            jax.ShapeDtypeStruct(vp.shape, v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qp, dop, mp, lp, deltap, kp, vp)
+
+    dq, = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq,
+            block_k=bk, q_len=s_q, kv_len=s_kv, out_dtype=q3.dtype,
+        ),
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), **mem),  # k
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), **mem),  # v
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), **mem),  # q
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), **mem),  # do
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i), **mem),        # m
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i), **mem),        # l
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i), **mem),        # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), **mem),
+        ],
+        out_shape=[jax.ShapeDtypeStruct(qp.shape, q3.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(kp, vp, qp, dop, mp, lp, deltap)
+    return dq[:, :s_q], dk[:, :s_kv], dv[:, :s_kv]
+
+
 def _bwd_blocked(q3, k3, v3, o3, m, l, do3, causal, block_k):
     """FlashAttention-2 backward at the XLA level: a scan over K/V blocks
     recomputing P from the saved (m, l) — never materializes [S, S]."""
@@ -205,19 +410,23 @@ def _bwd_blocked(q3, k3, v3, o3, m, l, do3, causal, block_k):
     return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q3, k3, v3, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q3, k3, v3, causal, block_q, block_k, interpret, bwd):
     out, _, _ = _fwd(q3, k3, v3, causal, block_q, block_k, interpret)
     return out
 
 
-def _flash_fwd(q3, k3, v3, causal, block_q, block_k, interpret):
+def _flash_fwd(q3, k3, v3, causal, block_q, block_k, interpret, bwd):
     out, m, l = _fwd(q3, k3, v3, causal, block_q, block_k, interpret)
     return out, (q3, k3, v3, out, m, l)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, do3):
+def _flash_bwd(causal, block_q, block_k, interpret, bwd, res, do3):
     q3, k3, v3, o3, m, l = res
+    if bwd == "pallas":
+        return _bwd_pallas(
+            q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret
+        )
     return _bwd_blocked(q3, k3, v3, o3, m, l, do3, causal, block_k)
 
 
@@ -230,7 +439,8 @@ def flash_supported() -> bool:
 
 
 def flash_attention(q, k, v, *, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, interpret: bool | None = None):
+                    block_k: int = 128, interpret: bool | None = None,
+                    bwd: str = "pallas"):
     """Tiled attention on [B, S, H, D] — drop-in for
     :func:`tpu_dist.nn.attention.full_attention` (same contract: f32
     softmax accumulation, output in ``q.dtype``).
@@ -239,10 +449,18 @@ def flash_attention(q, k, v, *, causal: bool = False, block_q: int = 128,
     dim ``D`` should be a multiple of 128 lanes for peak MXU utilization
     (64 works, at some padding cost). Sequence lengths are padded to the
     block size internally and masked exactly.
+
+    ``bwd``: ``'pallas'`` (default) runs the FlashAttention-2 backward as
+    two tiled Pallas kernels (dK/dV pass + dQ pass); ``'xla'`` keeps the
+    blockwise ``lax.scan`` formulation — same math, for A/B comparison
+    and as a numerics cross-check. (Either way the FORWARD needs the
+    Pallas module; off-TPU both run in interpret mode.)
     """
+    if bwd not in ("pallas", "xla"):
+        raise ValueError(f"bwd must be 'pallas' or 'xla', got {bwd!r}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, s, h, d = q.shape
     to3 = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, t.shape[1], d)
-    out3 = _flash(to3(q), to3(k), to3(v), causal, block_q, block_k, interpret)
+    out3 = _flash(to3(q), to3(k), to3(v), causal, block_q, block_k, interpret, bwd)
     return out3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
