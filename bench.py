@@ -129,11 +129,23 @@ CONFIGS = {
             "vit_b16_imagenet_flash", "vit_b16", 224, 1000, 64,
             sync_bn=False, flash=True, epoch_images=1_281_167,
         ),
+        # long-context showcase: 1024px -> S = 64^2+1 = 4097 tokens; the
+        # full train step (not just the attention micro-bench) at a length
+        # where the XLA path's score tensor is the memory bottleneck
+        BenchConfig(
+            "vit_b16_1024px_flash", "vit_b16", 1024, 1000, 8,
+            sync_bn=False, flash=True, epoch_images=1_281_167,
+        ),
+        BenchConfig(
+            "vit_b16_1024px_xla", "vit_b16", 1024, 1000, 8,
+            sync_bn=False, epoch_images=1_281_167,
+        ),
     ]
 }
 
 
-def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None) -> dict:
+def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None,
+        profile_dir: str | None = None) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -206,11 +218,17 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None)
         state, metrics = call(state, images, labels, 0.1)
     jax.block_until_ready(state.params)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = call(state, images, labels, 0.1)
-    jax.block_until_ready(state.params)
-    dt = time.perf_counter() - t0
+    import contextlib
+
+    from tpu_dist.metrics.profiler import trace
+
+    prof = trace(profile_dir) if profile_dir else contextlib.nullcontext()
+    with prof:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = call(state, images, labels, 0.1)
+        jax.block_until_ready(state.params)
+        dt = time.perf_counter() - t0
 
     img_per_sec = batch * steps / dt
     return {
@@ -327,7 +345,7 @@ def run_attn(seq_len: int, steps: int, warmup: int, *, batch: int = 0,
             jax.block_until_ready(out)
             return (time.perf_counter() - t0) / steps, None
         except Exception as e:  # RESOURCE_EXHAUSTED at S=16k is the point
-            return None, f"{type(e).__name__}: {str(e).splitlines()[0][:160]}"
+            return None, f"{type(e).__name__}: {(str(e).splitlines() or [''])[0][:160]}"
 
     flash_s, flash_err = bench_impl("flash")
     xla_s, xla_err = bench_impl("xla")
@@ -557,6 +575,11 @@ def main() -> None:
     )
     p.add_argument("--attn_batch", type=int, default=0,
                    help="batch for --attn (0 = ~32k tokens/step)")
+    p.add_argument(
+        "--profile_dir", default="",
+        help="capture an XLA/TPU profile of the measured steps to this dir "
+             "(TensorBoard profile tab; single-config mode only)",
+    )
     p.add_argument("--causal", action="store_true",
                    help="causal masking for --attn")
     p.add_argument(
@@ -637,9 +660,21 @@ def main() -> None:
             print(json.dumps(out))
     elif args.all:
         for name in sorted(CONFIGS):
-            print(json.dumps(run(CONFIGS[name], args.steps, args.warmup)))
+            try:
+                print(json.dumps(run(CONFIGS[name], args.steps, args.warmup)),
+                      flush=True)
+            except Exception as e:  # e.g. RESOURCE_EXHAUSTED on the
+                # 1024px XLA-attention config: record it, keep sweeping
+                print(json.dumps({
+                    "metric": f"{name}_train_throughput", "value": None,
+                    "unit": "images/sec",
+                    "error": f"{type(e).__name__}: {(str(e).splitlines() or [''])[0][:200]}",
+                }), flush=True)
     else:
-        print(json.dumps(run(CONFIGS[args.config], args.steps, args.warmup)))
+        print(json.dumps(run(
+            CONFIGS[args.config], args.steps, args.warmup,
+            profile_dir=args.profile_dir or None,
+        )))
 
 
 if __name__ == "__main__":

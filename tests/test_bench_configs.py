@@ -34,3 +34,14 @@ def test_bench_help_runs():
     )
     assert out.returncode == 0
     assert "--scaling" in out.stdout and "--all" in out.stdout
+
+
+def test_attn_microbench_smoke():
+    """run_attn JSON contract at a tiny length (interpret mode on CPU)."""
+    out = bench.run_attn(64, steps=1, warmup=0, batch=1)
+    assert out["seq_len"] == 64
+    assert out["unit"] == "tokens/sec"
+    assert out["heads"] == 8 and out["head_dim"] == 128
+    # flash ran (value present) — xla too on these tiny shapes
+    assert out["flash_ms"] and out["xla_ms"]
+    assert out["value"] and out["vs_baseline"]

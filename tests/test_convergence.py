@@ -62,3 +62,55 @@ def test_trainer_converges_on_learnable_dataset():
     )
     out = Trainer(cfg).fit()
     assert out["val_top1"] > 55.0, out  # chance = 25%
+
+
+def test_multifactor_convergence_and_schedule_matters(tmp_path):
+    """VERDICT r2 #4: discriminating convergence evidence. The multifactor
+    task (16 classes, two independent factors, 20% train-label noise,
+    data/synthetic.py::synthetic_multifactor) is NOT memorizable in one
+    epoch — the loss must *keep declining* across 20 epochs — and the
+    reference's MultiStepLR decay (distributed.py:64 semantics) must
+    *visibly matter*: constant LR at the same base rate keeps bouncing
+    off the label-noise floor and lands several val points lower.
+    Measured operating point (8-dev CPU mesh, seed 0): scheduled 98.9%
+    vs constant 93.7% val top-1; asserts keep wide margins."""
+    import json
+
+    from tpu_dist.config import TrainConfig
+    from tpu_dist.train.trainer import Trainer, register_model
+    from tests.helpers import tiny_resnet
+
+    register_model("tiny_mf", lambda num_classes=16: tiny_resnet(num_classes))
+
+    def fit(milestones, tag):
+        cfg = TrainConfig(
+            dataset="synthetic_multifactor", model="tiny_mf", num_classes=16,
+            batch_size=256, epochs=20, eval_every=20, lr=0.8,
+            lr_milestones=milestones, lr_gamma=0.1, synthetic_n=4096,
+            log_every=1000, sync_bn=True, seed=0,
+            log_file=str(tmp_path / f"{tag}.jsonl"),
+        )
+        out = Trainer(cfg).fit()
+        losses = [
+            json.loads(line)["loss"]
+            for line in open(tmp_path / f"{tag}.jsonl")
+            if json.loads(line).get("kind") == "train_epoch"
+        ]
+        return out, losses
+
+    sched, losses = fit((10, 15), "sched")
+    # a declining CURVE, not epoch-0 memorization: starts near ln(16) and
+    # is still there after a FULL epoch (the quadrant task this replaces
+    # was memorized by mid-epoch-0), then keeps dropping for many epochs
+    assert losses[0] > 2.3, losses[0]
+    assert losses[1] > 2.0, losses[1]
+    assert losses[-1] < 0.5 * losses[1], (losses[1], losses[-1])
+    # final-accuracy window: way above 6.25% chance, and the train loss
+    # sits at the label-noise floor rather than 0.0 (no flatline-at-100)
+    assert 90.0 <= sched["val_top1"] <= 100.0, sched
+    assert losses[-1] > 0.7, losses[-1]  # 20% resampled labels keep CE > 0
+
+    const, _ = fit((10**6,), "const")
+    # the schedule is load-bearing: disabling the milestones costs
+    # multiple validation points (measured ~5.3)
+    assert sched["val_top1"] - const["val_top1"] >= 2.0, (sched, const)

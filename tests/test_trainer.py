@@ -65,12 +65,16 @@ def test_config_argparse_bridge():
     p = add_reference_flags(argparse.ArgumentParser())
     args = p.parse_args(
         ["--batch_size", "128", "--lr", "0.05", "--grad_accu_steps", "4",
-         "--bf16", "--no_sync_bn", "--seed", "3"]
+         "--bf16", "--no_sync_bn", "--seed", "3",
+         "--lr_milestones", "10", "15", "--lr_gamma", "0.1"]
     )
     cfg = config_from_args(args)
     assert cfg.batch_size == 128 and cfg.lr == 0.05
     assert cfg.grad_accu_steps == 4 and cfg.bf16 and not cfg.sync_bn
     assert cfg.seed == 3
+    assert cfg.lr_milestones == (10, 15) and cfg.lr_gamma == 0.1
+    # defaults keep the reference's hard-coded schedule (distributed.py:64)
+    assert config_from_args(p.parse_args([])).lr_milestones == (60, 120, 160)
     # reference-compat flags accepted silently
     p.parse_args(["--local_rank", "2", "--gpu", "0,1"])
 

@@ -1,0 +1,62 @@
+#!/bin/bash
+# Round-4 TPU capture session: run ONCE when the tunnel recovers, in
+# decreasing order of VERDICT value. One TPU process at a time (each
+# bench/python run takes the machine lock; bench also waits --lock_wait).
+# Usage: bash tools/tpu_session_r04.sh [outdir]   (default /tmp/tpu_r04)
+cd /root/repo || exit 2
+OUT=${1:-/tmp/tpu_r04}
+mkdir -p "$OUT"
+log() { echo "$(date -u +%F_%T) $*" | tee -a "$OUT/session.log"; }
+
+# 0. single bounded probe — bail early if still wedged
+timeout -k 10 300 python - <<'PY' || { log "probe FAILED - tunnel still wedged"; exit 3; }
+from tpu_dist.comm import tpu_lock
+tpu_lock.guard_or_exit("r04_probe")
+import jax
+d = jax.devices()
+assert d and d[0].platform != "cpu", d
+print("ALIVE", d, flush=True)
+PY
+log "tunnel alive"
+
+# 1. driver-contract default line (also exercises the compile cache)
+timeout -k 10 1200 python bench.py > "$OUT/BENCH_DEFAULT.json" 2>"$OUT/bench_default.err"
+log "default bench rc=$? $(cat "$OUT/BENCH_DEFAULT.json" 2>/dev/null | head -c 300)"
+
+# 2. flash long-seq crossover (this round's kernel showcase)
+timeout -k 10 2400 python bench.py --attn_all --steps 30 --warmup 5 \
+  > "$OUT/ATTN_ALL.json" 2>"$OUT/attn.err"
+log "attn_all rc=$?"
+
+# 3. ResNet-50 at b128 + s2d stem A/B (VERDICT #2)
+for cfg in resnet50_imagenet resnet50_imagenet_s2d; do
+  timeout -k 10 1800 python bench.py --config "$cfg" \
+    > "$OUT/BENCH_$cfg.json" 2>"$OUT/$cfg.err"
+  log "$cfg rc=$? $(cat "$OUT/BENCH_$cfg.json" 2>/dev/null | head -c 300)"
+done
+
+# 4. ResNet-50 profile capture (VERDICT #2 anatomy)
+timeout -k 10 1800 python bench.py --config resnet50_imagenet \
+  --profile_dir "$OUT/rn50_profile" > "$OUT/BENCH_rn50_profiled.json" 2>"$OUT/prof.err"
+log "rn50 profile rc=$?"
+
+# 5. ViT-B/16 flash vs xla at 224px, then the 1024px long-context pair
+for cfg in vit_b16_imagenet vit_b16_imagenet_flash vit_b16_1024px_flash vit_b16_1024px_xla; do
+  timeout -k 10 1800 python bench.py --config "$cfg" \
+    > "$OUT/BENCH_$cfg.json" 2>"$OUT/$cfg.err"
+  log "$cfg rc=$? $(cat "$OUT/BENCH_$cfg.json" 2>/dev/null | head -c 300)"
+done
+
+# 6. remaining --all rows (ga4, fp32, fused) for BENCH_ALL_r04
+timeout -k 10 3600 python bench.py --all > "$OUT/BENCH_ALL.json" 2>"$OUT/all.err"
+log "all rc=$?"
+
+# 7. discriminating convergence on real TPU (TPU_RUN_r04 exhibit):
+#    20 epochs multifactor, scheduled LR, fused device-resident epoch path
+timeout -k 10 2400 python -m tpu_dist.cli.train \
+  --dataset synthetic_multifactor --model resnet18 --num_classes 16 \
+  --batch_size 256 --epochs 20 --lr 0.8 --lr_milestones 10 15 --lr_gamma 0.1 \
+  --synthetic_n 4096 --eval_every 5 --log_every 8 \
+  --log_file "$OUT/TPU_RUN_r04.jsonl" > "$OUT/TPU_RUN_r04.log" 2>&1
+log "convergence run rc=$? tail: $(tail -2 "$OUT/TPU_RUN_r04.log" | tr '\n' ' ')"
+log "session complete"

@@ -149,6 +149,12 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "(pre-r3 behavior — use when resuming a pre-r3 "
                         "adamw run)")
     p.add_argument("--lr_schedule", choices=("multistep", "cosine"), default=d.lr_schedule)
+    p.add_argument("--lr_milestones", type=int, nargs="+",
+                   default=list(d.lr_milestones), metavar="EPOCH",
+                   help="multistep decay epochs (reference hard-codes "
+                        "[60, 120, 160], distributed.py:64)")
+    p.add_argument("--lr_gamma", type=float, default=d.lr_gamma,
+                   help="multistep decay factor (reference: 0.2)")
     p.add_argument("--warmup_epochs", type=int, default=d.warmup_epochs,
                    help="linear warmup epochs (cosine schedule only)")
     p.add_argument("--label_smoothing", type=float, default=d.label_smoothing)
@@ -240,5 +246,7 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
 def config_from_args(args: argparse.Namespace, **overrides) -> TrainConfig:
     fields = {f.name for f in dataclasses.fields(TrainConfig)}
     kw = {k: v for k, v in vars(args).items() if k in fields}
+    if "lr_milestones" in kw:  # argparse nargs gives a list; config is a tuple
+        kw["lr_milestones"] = tuple(kw["lr_milestones"])
     kw.update(overrides)
     return TrainConfig(**kw)
