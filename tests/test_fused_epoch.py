@@ -57,3 +57,46 @@ def test_fused_epoch_reshuffles_between_epochs():
     # with lr=0 the only difference between epochs is batch order/augment →
     # metrics differ unless shuffling is broken
     assert float(m1["loss"]) != float(m2["loss"])
+
+
+def test_fused_epoch_grad_compression():
+    """The fused path honors the shared grad-compression contract: bf16
+    wire trains (finite, close to uncompressed), bad modes are refused at
+    build time (same validation as make_train_step)."""
+    import pytest
+
+    mesh = mesh_lib.data_parallel_mesh()
+    imgs, lbls = synthetic_cifar(256, 10, image_size=8, seed=0)
+    dx, dy = put_dataset_on_device(mesh, imgs, lbls)
+    model = TinyConvNet()
+    opt = SGD()
+    params, bn = model.init(jax.random.PRNGKey(0))
+    # host copies: the runner donates its input state, and device_put can
+    # alias rather than copy — a donated alias would poison the second use
+    params = jax.tree_util.tree_map(np.asarray, params)
+    bn = jax.tree_util.tree_map(np.asarray, bn)
+
+    def fresh_state():
+        return jax.device_put(
+            TrainState.create(params, bn, opt), mesh_lib.replicated(mesh)
+        )
+
+    plain = make_fused_epoch(
+        model.apply, opt, mesh, batch_per_device=4, compute_dtype=jnp.float32
+    )
+    comp = make_fused_epoch(
+        model.apply, opt, mesh, batch_per_device=4, compute_dtype=jnp.float32,
+        grad_compression="bf16",
+    )
+    s_p, m_p = plain(fresh_state(), dx, dy, 0.1, 0)
+    s_c, m_c = comp(fresh_state(), dx, dy, 0.1, 0)
+    assert np.isfinite(float(m_c["loss"]))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_p.params), jax.tree_util.tree_leaves(s_c.params)
+    ):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=3e-2, atol=3e-3)
+
+    with pytest.raises(ValueError, match="grad_compression"):
+        make_fused_epoch(
+            model.apply, opt, mesh, batch_per_device=4, grad_compression="fp16"
+        )

@@ -101,6 +101,9 @@ class TrainConfig:
     flash_attention: bool = False  # Pallas tiled attention (ops/flash_attention.py)
                                    # for transformer models; process-global
     remat: bool = False            # jax.checkpoint the forward (less memory)
+    grad_compression: str = "none" # none | bf16: gradient wire format for the
+                                   # cross-replica reduce (DDP bf16_compress_hook
+                                   # equivalent; halves grad ICI/DCN traffic)
 
     # -- bench / smoke / debug ---------------------------------------------
     steps_per_epoch: Optional[int] = None  # cap steps (smoke tests / benches)
@@ -178,6 +181,13 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "models — O(block^2) memory instead of O(S^2)")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint the forward (less activation memory)")
+    p.add_argument("--grad_compression", choices=("none", "bf16"),
+                   default=d.grad_compression,
+                   help="gradient wire format for the cross-replica reduce: "
+                        "bf16 halves gradient ICI/DCN traffic (torch DDP "
+                        "bf16_compress_hook equivalent; update math stays "
+                        "f32). Not applied under --fsdp (GSPMD-inserted "
+                        "collectives)")
     p.add_argument("--no_sync_bn", dest="sync_bn", action="store_false",
                    help="per-replica BatchNorm statistics (SyncBN off)")
     p.add_argument("--no_nan_guard", dest="nan_guard", action="store_false")

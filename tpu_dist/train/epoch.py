@@ -81,13 +81,20 @@ def make_fused_epoch(
     mean: np.ndarray = CIFAR100_MEAN,
     std: np.ndarray = CIFAR100_STD,
     moe_aux_coef: float = 0.01,
+    grad_compression: str = "none",
     model_kwargs: dict | None = None,
 ):
     """Build ``epoch(state, images_u8, labels, lr, epoch_idx) ->
     (state, metrics)`` running every step of the epoch on device.
 
     ``images_u8``/``labels`` from :func:`put_dataset_on_device`.
+    ``grad_compression``: same contract as ``make_train_step`` (bf16 wire
+    format for the grad pmean — the shared helpers in ``train/step.py``
+    define it once for both paths).
     """
+    from tpu_dist.train.step import validate_grad_compression  # noqa: PLC0415
+
+    validate_grad_compression(grad_compression)
     bn_axis = axis if sync_bn else None
     mean_c = jnp.asarray(mean, jnp.float32)
     std_inv_c = jnp.asarray(1.0 / std, jnp.float32)
@@ -134,8 +141,10 @@ def make_fused_epoch(
             ys = jnp.take(labels, idx, axis=0)
             x = augment(imgs, jax.random.fold_in(base, i + 1))
 
+            from tpu_dist.train.step import compressed_pmean  # noqa: PLC0415
+
             (loss, (new_bn, logits)), grads = grad_fn(state.params, state.bn_state, x, ys)
-            grads = lax.pmean(grads, axis)
+            grads = compressed_pmean(grads, axis, grad_compression)
             if not sync_bn:
                 new_bn = lax.pmean(new_bn, axis)
             new_params, new_opt = optimizer.update(grads, state.opt_state, state.params, lr)

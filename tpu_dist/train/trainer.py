@@ -255,6 +255,12 @@ class Trainer:
                     "debug_replica_check asserts replicated params; under "
                     "fsdp params are sharded by design"
                 )
+            if cfg.grad_compression != "none":
+                rank0_print(
+                    "WARNING: --grad_compression has no effect under --fsdp "
+                    "— the engine's collectives are GSPMD-inserted from "
+                    "sharding specs, not hookable per-tensor"
+                )
             if cfg.flash_attention:
                 raise ValueError(
                     "--fsdp with --flash_attention is not supported: the "
@@ -605,6 +611,7 @@ class Trainer:
                 batch_per_device=cfg.batch_size // self.n_devices,
                 sync_bn=cfg.sync_bn, compute_dtype=compute_dtype,
                 moe_aux_coef=cfg.moe_aux_coef,
+                grad_compression=cfg.grad_compression,
                 model_kwargs=self._attn_model_kwargs() or None, **stats,
             )
             # round the test set UP to a device multiple with label=-1
@@ -681,6 +688,7 @@ class Trainer:
             pp_axis=mesh_lib.PIPE_AXIS if cfg.pp > 1 else None,
             param_specs=self._param_specs,
             remat=cfg.remat,
+            grad_compression=cfg.grad_compression,
             model_kwargs=mk or None,
         )
 
