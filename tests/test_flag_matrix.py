@@ -15,6 +15,8 @@ COMBOS = [
     dict(sync_bn=False, grad_accu_steps=2, label_smoothing=0.1),
     dict(bf16=True, grad_clip_norm=1.0, lr_schedule="cosine", warmup_epochs=1),
     dict(fused_optimizer=True, bf16=True),
+    dict(bf16=True, grad_compression="bf16", grad_clip_norm=1.0),
+    dict(grad_compression="bf16", shard_weight_update=True),
 ]
 
 

@@ -198,3 +198,40 @@ def test_trainer_3d_ulysses_heads_validation():
     # tp=2 -> 2 local heads; sp=4 would need 8 global: clear early error
     with pytest.raises(ValueError, match="per-shard heads"):
         Trainer(TrainConfig(**{**base, "batch_size": 32}, tp=2, sp=4))
+
+
+def test_dp_sp_ring_flash_training_matches_single_device():
+    """DP×SP with the RING-FLASH composition (Pallas local tiles inside
+    the K/V rotation, ops/flash_attention.py::ring_flash_attention) trains
+    to the same parameters as single-device XLA attention."""
+    model = _model()
+    opt = SGD()
+
+    mesh2d = mesh_lib.device_mesh([2, 4], ["data", "seq"])
+    mesh1 = mesh_lib.device_mesh([1], ["data"], jax.devices()[:1])
+
+    step_sp = make_train_step(
+        model.apply, opt, mesh2d, sync_bn=False, donate=False, seq_axis="seq",
+        model_kwargs={"attn_impl": "flash"},
+    )
+    step_1 = make_train_step(model.apply, opt, mesh1, sync_bn=False, donate=False)
+
+    s_sp = _state(model, mesh2d)
+    s_1 = _state(model, mesh1)
+
+    rng = np.random.default_rng(2)
+    for _ in range(3):
+        x = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
+        y = rng.integers(0, 5, 8).astype(np.int32)
+        s_sp, m_sp = step_sp(
+            s_sp, mesh_lib.shard_batch(mesh2d, x), mesh_lib.shard_batch(mesh2d, y), 0.05
+        )
+        s_1, m_1 = step_1(
+            s_1, mesh_lib.shard_batch(mesh1, x), mesh_lib.shard_batch(mesh1, y), 0.05
+        )
+
+    np.testing.assert_allclose(float(m_sp["loss"]), float(m_1["loss"]), rtol=1e-4)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_sp.params), jax.tree_util.tree_leaves(s_1.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-5)

@@ -172,36 +172,29 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
     return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 
-_warned_flash_ring = False
-
-
 def attention(q, k, v, *, causal: bool = False, seq_axis: Optional[str] = None,
               impl: Optional[str] = None, sp_mode: str = "ring"):
     """Dispatch: sequence-parallel attention when a sequence axis is given
     (``sp_mode``: "ring" rotation or "ulysses" all-to-all), else full
     (``impl``/module default selecting XLA vs Pallas flash).
 
-    Under the RING the Pallas kernel does not apply (the ring is its own
-    blockwise online softmax — it never materializes a global [S, S]; each
-    rotation computes one [S/n, S/n] local tile): a flash request is
-    acknowledged with a one-time warning rather than silently honored.
-    Under ULYSSES the flash impl applies directly (the local computation is
-    full-sequence attention)."""
+    Under the RING the flash impl selects
+    :func:`tpu_dist.ops.flash_attention.ring_flash_attention`: the ring
+    already tiles ACROSS devices (each rotation sees one [S/n, S/n] local
+    tile, never a global [S, S]), and the Pallas kernels tile WITHIN the
+    device, taking the per-rotation working set from O(S_local²) HBM down
+    to O(block²) VMEM. Under ULYSSES the flash impl applies directly (the
+    local computation is full-sequence attention)."""
     if seq_axis is not None:
         if sp_mode == "ulysses":
             return ulysses_attention(q, k, v, seq_axis, causal=causal, impl=impl)
         if sp_mode != "ring":
             raise ValueError(f"sp_mode must be 'ring' or 'ulysses', got {sp_mode!r}")
         if _resolve_impl(impl) == "flash":
-            global _warned_flash_ring
-            if not _warned_flash_ring:
-                _warned_flash_ring = True
-                print(
-                    "tpu_dist: NOTE — flash attention impl does not apply under "
-                    "ring sequence parallelism (itself blockwise online-softmax,"
-                    " no global [S,S] materialized); use --sp_mode ulysses to "
-                    "combine flash with SP",
-                    flush=True,
-                )
+            from tpu_dist.ops.flash_attention import (  # noqa: PLC0415
+                ring_flash_attention,
+            )
+
+            return ring_flash_attention(q, k, v, seq_axis, causal=causal)
         return ring_attention(q, k, v, seq_axis, causal=causal)
     return full_attention(q, k, v, causal=causal, impl=impl)

@@ -275,20 +275,27 @@ def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, m_ref, l_ref, delta_ref,
         dq_ref[0] = dq_scr[:].astype(out_dtype)
 
 
-def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret):
+def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret,
+                want=("dq", "dk", "dv"), delta=None):
     """Pallas FlashAttention-2 backward: two tiled passes (dK/dV then dQ),
     O(block²) VMEM working set, never materializing [S, S] — the TPU-kernel
     sibling of the XLA-level ``_bwd_blocked`` (kept for A/B and as the
-    ``bwd='xla'`` escape hatch)."""
+    ``bwd='xla'`` escape hatch).
+
+    ``want`` selects which gradients to compute; unwanted slots are None.
+    ``delta`` (rowsum(do·o), [BH, S]) may be passed precomputed — the ring
+    backward hoists it out of its rotation scan (it is K/V-independent).
+    """
     bh, s_q, d = q3.shape
     s_kv = k3.shape[1]
     bq = min(block_q, -(-s_q // 8) * 8)
     bk = min(block_k, -(-s_kv // 8) * 8)
     scale = 1.0 / float(d) ** 0.5
 
-    delta = jnp.sum(
-        do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1
-    )                                                      # [BH, S]
+    if delta is None:
+        delta = jnp.sum(
+            do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1
+        )                                                  # [BH, S]
     qp = _pad_to(q3, bq, 1)
     dop = _pad_to(do3, bq, 1)
     mp = _pad_to(m, bq, 1)
@@ -311,11 +318,31 @@ def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret):
         pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), **mem),  # k
         pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), **mem),  # v
     ]
-    dk, dv = pl.pallas_call(
+    dq = dk = dv = None
+    if "dk" in want or "dv" in want:
+        dk, dv = _bwd_pallas_dkdv_call(
+            qp, dop, mp, lp, deltap, kp, vp, q_specs, kv_specs, mem,
+            scale, causal, bq, bk, s_q, s_kv, bh, n_q, n_k, d,
+            k3.dtype, v3.dtype, interpret,
+        )
+        dk, dv = dk[:, :s_kv], dv[:, :s_kv]
+    if "dq" in want:
+        dq = _bwd_pallas_dq_call(
+            qp, dop, mp, lp, deltap, kp, vp, mem,
+            scale, causal, bq, bk, s_q, s_kv, bh, n_q, n_k, d,
+            q3.dtype, interpret,
+        )[:, :s_q]
+    return dq, dk, dv
+
+
+def _bwd_pallas_dkdv_call(qp, dop, mp, lp, deltap, kp, vp, q_specs, kv_specs,
+                          mem, scale, causal, bq, bk, s_q, s_kv, bh, n_q, n_k,
+                          d, k_dtype, v_dtype, interpret):
+    return pl.pallas_call(
         functools.partial(
             _bwd_dkdv_kernel, scale=scale, causal=causal, block_q=bq,
             block_k=bk, q_len=s_q, kv_len=s_kv,
-            k_dtype=k3.dtype, v_dtype=v3.dtype,
+            k_dtype=k_dtype, v_dtype=v_dtype,
         ),
         grid=(bh, n_k, n_q),
         in_specs=q_specs + kv_specs,
@@ -324,8 +351,8 @@ def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), **mem),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(kp.shape, k3.dtype),
-            jax.ShapeDtypeStruct(vp.shape, v3.dtype),
+            jax.ShapeDtypeStruct(kp.shape, k_dtype),
+            jax.ShapeDtypeStruct(vp.shape, v_dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -337,10 +364,14 @@ def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret):
         interpret=interpret,
     )(qp, dop, mp, lp, deltap, kp, vp)
 
+
+def _bwd_pallas_dq_call(qp, dop, mp, lp, deltap, kp, vp, mem, scale, causal,
+                        bq, bk, s_q, s_kv, bh, n_q, n_k, d, q_dtype,
+                        interpret):
     dq, = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq,
-            block_k=bk, q_len=s_q, kv_len=s_kv, out_dtype=q3.dtype,
+            block_k=bk, q_len=s_q, kv_len=s_kv, out_dtype=q_dtype,
         ),
         grid=(bh, n_q, n_k),
         in_specs=[
@@ -355,14 +386,14 @@ def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret):
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), **mem),
         ],
-        out_shape=[jax.ShapeDtypeStruct(qp.shape, q3.dtype)],
+        out_shape=[jax.ShapeDtypeStruct(qp.shape, q_dtype)],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(kp, vp, qp, dop, mp, lp, deltap)
-    return dq[:, :s_q], dk[:, :s_kv], dv[:, :s_kv]
+    return dq
 
 
 def _bwd_blocked(q3, k3, v3, o3, m, l, do3, causal, block_k):
@@ -436,6 +467,176 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_supported() -> bool:
     """True when the Pallas TPU backend imported (interpret mode included)."""
     return pltpu is not None
+
+
+# ---------------------------------------------------------------------------
+# Ring flash attention: the Pallas kernels composed with sequence-parallel
+# K/V rotation (the ring-attention scheme of nn/attention.py), so BOTH
+# memory dimensions are tiled — across devices by the ring, within a device
+# by the kernel. The trick that makes the composition cheap: under the ring,
+# causal masking at a given rotation is block-structured — the (my, kv_idx)
+# pair is either fully unmasked (kv_idx < my), fully masked (kv_idx > my),
+# or the diagonal (kv_idx == my), where global offsets cancel and the
+# kernel's RELATIVE causal mask is exactly right. A 3-way lax.switch per
+# rotation picks the variant; no global-position plumbing enters the
+# kernels. Backward follows the ring-flash recipe: dq accumulates at home,
+# (dk, dv) accumulators rotate WITH k/v and arrive home after the full
+# cycle; each rotation reuses the FlashAttention-2 kernels with the global
+# (m, l, delta) statistics, which are valid for any K/V block.
+# ---------------------------------------------------------------------------
+
+
+def _ring_perm(n):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _fwd_variants(q3, k3, v3, block_q, block_k, interpret):
+    """(full, diagonal-causal, masked) rotation forwards, lax.switch-ready.
+    Each returns (out_j [BH,S,D], m_j [BH,S], l_j [BH,S])."""
+    def full(kk, vv):
+        return _fwd(q3, kk, vv, False, block_q, block_k, interpret)
+
+    def diag(kk, vv):
+        return _fwd(q3, kk, vv, True, block_q, block_k, interpret)
+
+    def masked(kk, vv):
+        bh, s_q, _ = q3.shape
+        return (
+            jnp.zeros_like(q3),
+            jnp.full((bh, s_q), _NEG_INF, jnp.float32),
+            jnp.zeros((bh, s_q), jnp.float32),
+        )
+
+    return full, diag, masked
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q3, k3, v3, axis_name, causal, block_q, block_k, interpret):
+    out, _, _ = _ring_flash_fwd_impl(
+        q3, k3, v3, axis_name, causal, block_q, block_k, interpret
+    )
+    return out
+
+
+def _ring_flash_fwd_impl(q3, k3, v3, axis_name, causal, block_q, block_k,
+                         interpret):
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    bh, s_q, d = q3.shape
+    full, diag, masked = _fwd_variants(q3, k3, v3, block_q, block_k, interpret)
+
+    def rotation(carry, _):
+        m, l, acc, kk, vv, kv_idx = carry
+        if causal:
+            case = jnp.where(kv_idx < my, 0, jnp.where(kv_idx == my, 1, 2))
+            out_j, m_j, l_j = lax.switch(case, (full, diag, masked), kk, vv)
+        else:
+            out_j, m_j, l_j = full(kk, vv)
+        # merge the rotation's (normalized) block into the running stats
+        m_new = jnp.maximum(m, m_j)
+        corr = jnp.exp(m - m_new)          # m starts at _NEG_INF (finite)
+        corr_j = jnp.exp(m_j - m_new)
+        acc = (
+            acc * corr[..., None]
+            + out_j.astype(jnp.float32) * (l_j * corr_j)[..., None]
+        )
+        l = l * corr + l_j * corr_j
+        perm = _ring_perm(n)
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return (m_new, l, acc, kk, vv, (kv_idx - 1) % n), None
+
+    m0 = jnp.full((bh, s_q), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bh, s_q), jnp.float32)
+    acc0 = jnp.zeros((bh, s_q, d), jnp.float32)
+    (m, l, acc, _, _, _), _ = lax.scan(
+        rotation, (m0, l0, acc0, k3, v3, my), None, length=n
+    )
+    out = (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q3.dtype)
+    return out, m, l
+
+
+def _ring_flash_fwd(q3, k3, v3, axis_name, causal, block_q, block_k, interpret):
+    out, m, l = _ring_flash_fwd_impl(
+        q3, k3, v3, axis_name, causal, block_q, block_k, interpret
+    )
+    return out, (q3, k3, v3, out, m, l)
+
+
+def _ring_flash_bwd(axis_name, causal, block_q, block_k, interpret, res, do3):
+    q3, k3, v3, o3, m, l = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    # delta is K/V-independent: compute ONCE, not per rotation
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
+
+    def blk(kk, vv, blk_causal):
+        dq_j, dk_j, dv_j = _bwd_pallas(
+            q3, kk, vv, o3, m, l, do3, blk_causal, block_q, block_k,
+            interpret, delta=delta,
+        )
+        return dk_j, dv_j, dq_j
+
+    def full(kk, vv):
+        return blk(kk, vv, False)
+
+    def diag(kk, vv):
+        return blk(kk, vv, True)
+
+    def masked(kk, vv):
+        return jnp.zeros_like(kk), jnp.zeros_like(vv), jnp.zeros_like(q3)
+
+    def rotation(carry, _):
+        kk, vv, dka, dva, dq, kv_idx = carry
+        if causal:
+            case = jnp.where(kv_idx < my, 0, jnp.where(kv_idx == my, 1, 2))
+            dk_j, dv_j, dq_j = lax.switch(case, (full, diag, masked), kk, vv)
+        else:
+            dk_j, dv_j, dq_j = full(kk, vv)
+        dka = dka + dk_j
+        dva = dva + dv_j
+        dq = dq + dq_j.astype(dq.dtype)
+        # the grad accumulators ride the ring WITH their k/v block; after
+        # the full cycle they arrive back at the block's home device
+        perm = _ring_perm(n)
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        dka = lax.ppermute(dka, axis_name, perm)
+        dva = lax.ppermute(dva, axis_name, perm)
+        return (kk, vv, dka, dva, dq, (kv_idx - 1) % n), None
+
+    dq0 = jnp.zeros(q3.shape, jnp.float32)
+    (kk, vv, dka, dva, dq, _), _ = lax.scan(
+        rotation,
+        (k3, v3, jnp.zeros_like(k3, jnp.float32),
+         jnp.zeros_like(v3, jnp.float32), dq0, my),
+        None,
+        length=n,
+    )
+    return dq.astype(q3.dtype), dka.astype(k3.dtype), dva.astype(v3.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                         block_q: int = 128, block_k: int = 128,
+                         interpret: bool | None = None):
+    """Sequence-parallel flash attention on [B, S_local, H, D] shards —
+    drop-in for :func:`tpu_dist.nn.attention.ring_attention` with the
+    local tile computed by the Pallas kernels instead of an XLA einsum.
+    Per-device peak memory drops from O(S_local²) (the ring's per-rotation
+    score tile) to O(block²); causal rotations entirely above the diagonal
+    are skipped (a 3-way ``lax.switch``). Call inside ``shard_map`` with
+    the sequence dim sharded over ``axis_name``."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, h, d = q.shape
+    to3 = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, t.shape[1], d)
+    out3 = _ring_flash(
+        to3(q), to3(k), to3(v), axis_name, causal, block_q, block_k, interpret
+    )
+    return out3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
 def flash_attention(q, k, v, *, causal: bool = False, block_q: int = 128,

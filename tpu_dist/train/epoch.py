@@ -92,7 +92,10 @@ def make_fused_epoch(
     format for the grad pmean — the shared helpers in ``train/step.py``
     define it once for both paths).
     """
-    from tpu_dist.train.step import validate_grad_compression  # noqa: PLC0415
+    from tpu_dist.train.step import (  # noqa: PLC0415
+        compressed_pmean,
+        validate_grad_compression,
+    )
 
     validate_grad_compression(grad_compression)
     bn_axis = axis if sync_bn else None
@@ -140,8 +143,6 @@ def make_fused_epoch(
             imgs = jnp.take(images_u8, idx, axis=0)
             ys = jnp.take(labels, idx, axis=0)
             x = augment(imgs, jax.random.fold_in(base, i + 1))
-
-            from tpu_dist.train.step import compressed_pmean  # noqa: PLC0415
 
             (loss, (new_bn, logits)), grads = grad_fn(state.params, state.bn_state, x, ys)
             grads = compressed_pmean(grads, axis, grad_compression)
