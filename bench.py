@@ -331,7 +331,12 @@ def run_attn(seq_len: int, steps: int, warmup: int, *, batch: int = 0,
 
     def bench_impl(impl: str):
         def loss(q, k, v):
-            out = full_attention(q, k, v, causal=causal, impl=impl)
+            if impl == "flash_xla_bwd":  # A/B: Pallas fwd, lax.scan bwd
+                from tpu_dist.ops.flash_attention import flash_attention
+
+                out = flash_attention(q, k, v, causal=causal, bwd="xla")
+            else:
+                out = full_attention(q, k, v, causal=causal, impl=impl)
             return out.astype(jnp.float32).sum()
 
         step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
@@ -349,6 +354,9 @@ def run_attn(seq_len: int, steps: int, warmup: int, *, batch: int = 0,
 
     flash_s, flash_err = bench_impl("flash")
     xla_s, xla_err = bench_impl("xla")
+    # the round-4 Pallas backward vs the XLA-scan backward, same forward —
+    # skipped when the flash forward itself could not run
+    fxb_s, fxb_err = bench_impl("flash_xla_bwd") if flash_s else (None, "skipped")
 
     # analytic fwd+bwd FLOPs (QK^T + PV fwd = 4·S²·D/head; FA2 bwd ≈ 2.5×):
     # XLA cost analysis can't see inside pallas_call, so both impls use the
@@ -370,6 +378,8 @@ def run_attn(seq_len: int, steps: int, warmup: int, *, batch: int = 0,
         "head_dim": d_head,
         "flash_ms": round(1000 * flash_s, 2) if flash_s else None,
         "xla_ms": round(1000 * xla_s, 2) if xla_s else None,
+        "flash_xla_bwd_ms": round(1000 * fxb_s, 2) if fxb_s else None,
+        "flash_xla_bwd_err": fxb_err,
         "flash_err": flash_err,
         "xla_err": xla_err,
         "mfu": _mfu(flops, flash_s, 1) if flash_s else None,

@@ -270,3 +270,22 @@ def test_ring_flash_grads_match_full():
                 np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5,
                 err_msg=f"d{name} (causal={causal})",
             )
+
+
+def test_ring_flash_bf16_matches_single_device_flash():
+    """bf16 inputs (the TPU training dtype): per-rotation partials merge
+    in f32 — the ring result must stay within ONE bf16 rounding of the
+    single-device flash kernel, not accumulate a fresh quantization per
+    rotation."""
+    from tpu_dist.ops.flash_attention import flash_attention
+
+    mesh = mesh_lib.device_mesh([4], ["seq"], jax.devices()[:4])
+    q, k, v = (t.astype(jnp.bfloat16) for t in _qkv(s=64, seed=8))
+    out = np.asarray(
+        _ring_flash_fn(mesh, causal=False)(q, k, v), dtype=np.float32
+    )
+    ref = np.asarray(
+        flash_attention(q, k, v, block_q=16, block_k=16), dtype=np.float32
+    )
+    # bf16 has ~2^-8 relative precision; one rounding of each is ~1.6e-2
+    np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)

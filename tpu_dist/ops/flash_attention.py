@@ -105,8 +105,12 @@ def _pad_to(x, mult, axis):
     return jnp.pad(x, widths)
 
 
-def _fwd(q3, k3, v3, causal, block_q, block_k, interpret):
-    """[BH, S, D] inputs → (out [BH, S, D], m [BH, S], l [BH, S])."""
+def _fwd(q3, k3, v3, causal, block_q, block_k, interpret, out_dtype=None):
+    """[BH, S, D] inputs → (out [BH, S, D], m [BH, S], l [BH, S]).
+
+    ``out_dtype`` overrides the output dtype (default: ``q3.dtype``) — the
+    ring composition asks for f32 so per-rotation partials merge without a
+    bf16 quantization per rotation."""
     if pltpu is None:  # pragma: no cover
         raise RuntimeError(
             "flash_attention requires jax.experimental.pallas.tpu (even in "
@@ -125,7 +129,7 @@ def _fwd(q3, k3, v3, causal, block_q, block_k, interpret):
 
     kern = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        kv_len=s_kv, out_dtype=q3.dtype,
+        kv_len=s_kv, out_dtype=out_dtype or q3.dtype,
     )
     mem = {"memory_space": pltpu.VMEM}
     out, m, l = pl.pallas_call(
@@ -142,7 +146,7 @@ def _fwd(q3, k3, v3, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, bq), lambda b, i, j: (b, i), **mem),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(qp.shape, q3.dtype),
+            jax.ShapeDtypeStruct(qp.shape, out_dtype or q3.dtype),
             jax.ShapeDtypeStruct(qp.shape[:2], jnp.float32),
             jax.ShapeDtypeStruct(qp.shape[:2], jnp.float32),
         ],
@@ -276,13 +280,12 @@ def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, m_ref, l_ref, delta_ref,
 
 
 def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret,
-                want=("dq", "dk", "dv"), delta=None):
+                delta=None):
     """Pallas FlashAttention-2 backward: two tiled passes (dK/dV then dQ),
     O(block²) VMEM working set, never materializing [S, S] — the TPU-kernel
     sibling of the XLA-level ``_bwd_blocked`` (kept for A/B and as the
     ``bwd='xla'`` escape hatch).
 
-    ``want`` selects which gradients to compute; unwanted slots are None.
     ``delta`` (rowsum(do·o), [BH, S]) may be passed precomputed — the ring
     backward hoists it out of its rotation scan (it is K/V-independent).
     """
@@ -318,31 +321,11 @@ def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret,
         pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), **mem),  # k
         pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), **mem),  # v
     ]
-    dq = dk = dv = None
-    if "dk" in want or "dv" in want:
-        dk, dv = _bwd_pallas_dkdv_call(
-            qp, dop, mp, lp, deltap, kp, vp, q_specs, kv_specs, mem,
-            scale, causal, bq, bk, s_q, s_kv, bh, n_q, n_k, d,
-            k3.dtype, v3.dtype, interpret,
-        )
-        dk, dv = dk[:, :s_kv], dv[:, :s_kv]
-    if "dq" in want:
-        dq = _bwd_pallas_dq_call(
-            qp, dop, mp, lp, deltap, kp, vp, mem,
-            scale, causal, bq, bk, s_q, s_kv, bh, n_q, n_k, d,
-            q3.dtype, interpret,
-        )[:, :s_q]
-    return dq, dk, dv
-
-
-def _bwd_pallas_dkdv_call(qp, dop, mp, lp, deltap, kp, vp, q_specs, kv_specs,
-                          mem, scale, causal, bq, bk, s_q, s_kv, bh, n_q, n_k,
-                          d, k_dtype, v_dtype, interpret):
-    return pl.pallas_call(
+    dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkdv_kernel, scale=scale, causal=causal, block_q=bq,
             block_k=bk, q_len=s_q, kv_len=s_kv,
-            k_dtype=k_dtype, v_dtype=v_dtype,
+            k_dtype=k3.dtype, v_dtype=v3.dtype,
         ),
         grid=(bh, n_k, n_q),
         in_specs=q_specs + kv_specs,
@@ -351,8 +334,8 @@ def _bwd_pallas_dkdv_call(qp, dop, mp, lp, deltap, kp, vp, q_specs, kv_specs,
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), **mem),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(kp.shape, k_dtype),
-            jax.ShapeDtypeStruct(vp.shape, v_dtype),
+            jax.ShapeDtypeStruct(kp.shape, k3.dtype),
+            jax.ShapeDtypeStruct(vp.shape, v3.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -364,14 +347,10 @@ def _bwd_pallas_dkdv_call(qp, dop, mp, lp, deltap, kp, vp, q_specs, kv_specs,
         interpret=interpret,
     )(qp, dop, mp, lp, deltap, kp, vp)
 
-
-def _bwd_pallas_dq_call(qp, dop, mp, lp, deltap, kp, vp, mem, scale, causal,
-                        bq, bk, s_q, s_kv, bh, n_q, n_k, d, q_dtype,
-                        interpret):
     dq, = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq,
-            block_k=bk, q_len=s_q, kv_len=s_kv, out_dtype=q_dtype,
+            block_k=bk, q_len=s_q, kv_len=s_kv, out_dtype=q3.dtype,
         ),
         grid=(bh, n_q, n_k),
         in_specs=[
@@ -386,14 +365,14 @@ def _bwd_pallas_dq_call(qp, dop, mp, lp, deltap, kp, vp, mem, scale, causal,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), **mem),
         ],
-        out_shape=[jax.ShapeDtypeStruct(qp.shape, q_dtype)],
+        out_shape=[jax.ShapeDtypeStruct(qp.shape, q3.dtype)],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(kp, vp, qp, dop, mp, lp, deltap)
-    return dq
+    return dq[:, :s_q], dk[:, :s_kv], dv[:, :s_kv]
 
 
 def _bwd_blocked(q3, k3, v3, o3, m, l, do3, causal, block_k):
@@ -492,17 +471,25 @@ def _ring_perm(n):
 
 def _fwd_variants(q3, k3, v3, block_q, block_k, interpret):
     """(full, diagonal-causal, masked) rotation forwards, lax.switch-ready.
-    Each returns (out_j [BH,S,D], m_j [BH,S], l_j [BH,S])."""
+    Each returns (out_j [BH,S,D] f32, m_j [BH,S], l_j [BH,S]) — partials
+    stay f32 so the cross-rotation merge never quantizes to the input
+    dtype (one bf16 round-off per rotation would otherwise accumulate)."""
     def full(kk, vv):
-        return _fwd(q3, kk, vv, False, block_q, block_k, interpret)
+        return _fwd(
+            q3, kk, vv, False, block_q, block_k, interpret,
+            out_dtype=jnp.float32,
+        )
 
     def diag(kk, vv):
-        return _fwd(q3, kk, vv, True, block_q, block_k, interpret)
+        return _fwd(
+            q3, kk, vv, True, block_q, block_k, interpret,
+            out_dtype=jnp.float32,
+        )
 
     def masked(kk, vv):
         bh, s_q, _ = q3.shape
         return (
-            jnp.zeros_like(q3),
+            jnp.zeros(q3.shape, jnp.float32),
             jnp.full((bh, s_q), _NEG_INF, jnp.float32),
             jnp.zeros((bh, s_q), jnp.float32),
         )
