@@ -1,0 +1,94 @@
+"""Worker for the multi-host × sequence-parallel RING-FLASH test.
+
+Launched by tests/test_multihost.py as 2 processes × 4 CPU devices: one
+8-device global mesh laid out ``[data=2, seq=4]`` HOST-MAJOR, so every
+seq group (the ring's ppermute neighborhood) is intra-host while the data
+axis crosses hosts (the DCN side of the split). The local attention tile
+runs the Pallas kernels in interpret mode — the full ring-flash
+composition (ops/flash_attention.py::ring_flash_attention) across process
+boundaries. The same ``run_sp_training`` is also called by the parent
+test in-process (1 process × 8 devices) as the reference.
+
+Usage: python tests/_mp_worker_sp.py <coordinator> <num_procs> <proc_id>
+"""
+
+import os
+import sys
+
+if __name__ == "__main__":
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+import jax  # noqa: E402
+
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _to_host(x) -> np.ndarray:
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(x))
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def run_sp_training():
+    """Train a tiny ViT 3 steps with ring-flash sequence parallelism on a
+    [data=2, seq=4] mesh built from ALL global devices; returns
+    (loss, replicated-leaf fingerprint)."""
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.nn.vit import ViTDef
+    from tpu_dist.train.optim import SGD
+    from tpu_dist.train.state import TrainState
+    from tpu_dist.train.step import make_train_step
+
+    n = jax.device_count()
+    mesh = mesh_lib.device_mesh([n // 4, 4], ["data", "seq"])
+
+    model = ViTDef(image_size=32, patch_size=4, dim=32, depth=2, heads=2,
+                   num_classes=5)
+    opt = SGD()
+    params, s = model.init(jax.random.PRNGKey(0))
+    st = TrainState.create(params, s, opt)
+    state = TrainState(
+        params=mesh_lib.place_host_tree(mesh, st.params),
+        bn_state=mesh_lib.place_host_tree(mesh, st.bn_state),
+        opt_state=mesh_lib.place_host_tree(mesh, st.opt_state),
+        step=mesh_lib.place_host_tree(mesh, st.step),
+    )
+    step = make_train_step(
+        model.apply, opt, mesh, sync_bn=False, donate=False,
+        seq_axis="seq", model_kwargs={"attn_impl": "flash"},
+    )
+
+    rng = np.random.default_rng(0)
+    all_x = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
+    all_y = rng.integers(0, 5, 8).astype(np.int32)
+    per = all_x.shape[0] // jax.process_count()
+    lo = jax.process_index() * per
+    xs = mesh_lib.shard_batch(mesh, all_x[lo:lo + per])
+    ys = mesh_lib.shard_batch(mesh, all_y[lo:lo + per])
+
+    for _ in range(3):
+        state, metrics = step(state, xs, ys, 0.05)
+    loss = float(_to_host(metrics["loss"]))
+    fp = float(_to_host(state.params["patch"]["w"]).sum())
+    return loss, fp
+
+
+def main(coordinator: str, num_procs: int, proc_id: int) -> None:
+    from tpu_dist.comm import mesh as mesh_lib
+
+    mesh_lib.initialize_distributed(coordinator, num_procs, proc_id)
+    assert jax.process_count() == num_procs
+    assert jax.local_device_count() == 4
+    loss, fp = run_sp_training()
+    print(f"SPRESULT {proc_id} {loss:.6f} {fp:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))

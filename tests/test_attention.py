@@ -281,11 +281,30 @@ def test_ring_flash_bf16_matches_single_device_flash():
 
     mesh = mesh_lib.device_mesh([4], ["seq"], jax.devices()[:4])
     q, k, v = (t.astype(jnp.bfloat16) for t in _qkv(s=64, seed=8))
-    out = np.asarray(
-        _ring_flash_fn(mesh, causal=False)(q, k, v), dtype=np.float32
-    )
+    fn = _ring_flash_fn(mesh, causal=False)
+    out = np.asarray(fn(q, k, v), dtype=np.float32)
     ref = np.asarray(
         flash_attention(q, k, v, block_q=16, block_k=16), dtype=np.float32
     )
     # bf16 has ~2^-8 relative precision; one rounding of each is ~1.6e-2
     np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+
+    # backward too: per-rotation grad partials accumulate in f32, so ring
+    # grads also stay within one bf16 rounding of the single-device kernel
+    ct = jax.random.normal(jax.random.PRNGKey(11), q.shape, jnp.bfloat16)
+
+    def g(f):
+        return jax.grad(
+            lambda q, k, v: jnp.vdot(
+                f(q, k, v).astype(jnp.float32), ct.astype(jnp.float32)
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+
+    g_ring = g(fn)
+    g_ref = g(lambda q, k, v: flash_attention(q, k, v, block_q=16, block_k=16))
+    for got, want, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got, dtype=np.float32), np.asarray(want, dtype=np.float32),
+            rtol=4e-2, atol=4e-2, err_msg=f"d{name} bf16",
+        )

@@ -110,3 +110,21 @@ def test_two_process_expert_parallel_matches_single_process():
     assert abs(loss - ref_loss) < 1e-4, (loss, ref_loss)
     assert abs(fp_rep - ref_rep) < 1e-4, (fp_rep, ref_rep)
     assert abs(fp_ep - ref_ep) < 1e-3, (fp_ep, ref_ep)
+
+
+def test_two_process_ring_flash_sp_matches_single_process():
+    """2 hosts × 4 devices, sp=4 RING-FLASH on a host-major [data=2, seq=4]
+    mesh: the ring's ppermute neighborhood stays intra-host while the data
+    axis crosses processes; the Pallas local tiles (interpret mode) run
+    the full ring-flash composition across a real jax.distributed
+    rendezvous. Workers agree with each other AND with the same training
+    run on a single-process 8-device mesh."""
+    results, _ = _launch_workers("_mp_worker_sp.py", "SPRESULT")
+    assert results["0"] == results["1"], results
+
+    from tests._mp_worker_sp import run_sp_training
+
+    ref_loss, ref_fp = run_sp_training()
+    loss, fp = (float(v) for v in results["0"])
+    assert abs(loss - ref_loss) < 1e-4, (loss, ref_loss)
+    assert abs(fp - ref_fp) < 1e-3, (fp, ref_fp)

@@ -280,7 +280,7 @@ def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, m_ref, l_ref, delta_ref,
 
 
 def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret,
-                delta=None):
+                delta=None, grad_dtype=None):
     """Pallas FlashAttention-2 backward: two tiled passes (dK/dV then dQ),
     O(block²) VMEM working set, never materializing [S, S] — the TPU-kernel
     sibling of the XLA-level ``_bwd_blocked`` (kept for A/B and as the
@@ -288,12 +288,19 @@ def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret,
 
     ``delta`` (rowsum(do·o), [BH, S]) may be passed precomputed — the ring
     backward hoists it out of its rotation scan (it is K/V-independent).
+    ``grad_dtype`` overrides the output dtypes (default: each input's own
+    dtype) — the ring backward asks for f32 so per-rotation grad partials
+    accumulate without a bf16 quantization per rotation (same invariant
+    as the forward's ``out_dtype`` override).
     """
     bh, s_q, d = q3.shape
     s_kv = k3.shape[1]
     bq = min(block_q, -(-s_q // 8) * 8)
     bk = min(block_k, -(-s_kv // 8) * 8)
     scale = 1.0 / float(d) ** 0.5
+    dq_dtype = grad_dtype or q3.dtype
+    dk_dtype = grad_dtype or k3.dtype
+    dv_dtype = grad_dtype or v3.dtype
 
     if delta is None:
         delta = jnp.sum(
@@ -325,7 +332,7 @@ def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret,
         functools.partial(
             _bwd_dkdv_kernel, scale=scale, causal=causal, block_q=bq,
             block_k=bk, q_len=s_q, kv_len=s_kv,
-            k_dtype=k3.dtype, v_dtype=v3.dtype,
+            k_dtype=dk_dtype, v_dtype=dv_dtype,
         ),
         grid=(bh, n_k, n_q),
         in_specs=q_specs + kv_specs,
@@ -334,8 +341,8 @@ def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret,
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), **mem),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(kp.shape, k3.dtype),
-            jax.ShapeDtypeStruct(vp.shape, v3.dtype),
+            jax.ShapeDtypeStruct(kp.shape, dk_dtype),
+            jax.ShapeDtypeStruct(vp.shape, dv_dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -350,7 +357,7 @@ def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret,
     dq, = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq,
-            block_k=bk, q_len=s_q, kv_len=s_kv, out_dtype=q3.dtype,
+            block_k=bk, q_len=s_q, kv_len=s_kv, out_dtype=dq_dtype,
         ),
         grid=(bh, n_q, n_k),
         in_specs=[
@@ -365,7 +372,7 @@ def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), **mem),
         ],
-        out_shape=[jax.ShapeDtypeStruct(qp.shape, q3.dtype)],
+        out_shape=[jax.ShapeDtypeStruct(qp.shape, dq_dtype)],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
@@ -523,10 +530,7 @@ def _ring_flash_fwd_impl(q3, k3, v3, axis_name, causal, block_q, block_k,
         m_new = jnp.maximum(m, m_j)
         corr = jnp.exp(m - m_new)          # m starts at _NEG_INF (finite)
         corr_j = jnp.exp(m_j - m_new)
-        acc = (
-            acc * corr[..., None]
-            + out_j.astype(jnp.float32) * (l_j * corr_j)[..., None]
-        )
+        acc = acc * corr[..., None] + out_j * (l_j * corr_j)[..., None]
         l = l * corr + l_j * corr_j
         perm = _ring_perm(n)
         kk = lax.ppermute(kk, axis_name, perm)
@@ -560,7 +564,7 @@ def _ring_flash_bwd(axis_name, causal, block_q, block_k, interpret, res, do3):
     def blk(kk, vv, blk_causal):
         dq_j, dk_j, dv_j = _bwd_pallas(
             q3, kk, vv, o3, m, l, do3, blk_causal, block_q, block_k,
-            interpret, delta=delta,
+            interpret, delta=delta, grad_dtype=jnp.float32,
         )
         return dk_j, dv_j, dq_j
 
