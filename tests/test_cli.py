@@ -55,3 +55,19 @@ def test_seed_flag_not_overridden_by_preset(monkeypatch):
 def test_unknown_flag_fails_loud():
     with pytest.raises(SystemExit):
         train.main(["--definitely_not_a_flag"])
+
+
+def test_backend_flag_xla_only():
+    """BASELINE north star names `--backend=xla`; nccl/gloo get a pointed
+    refusal, not a silent ignore."""
+    import argparse
+
+    import pytest
+
+    from tpu_dist.config import add_reference_flags, config_from_args
+
+    p = add_reference_flags(argparse.ArgumentParser())
+    cfg = config_from_args(p.parse_args(["--backend", "xla"]))
+    assert cfg is not None
+    with pytest.raises(SystemExit, match="nccl"):
+        config_from_args(p.parse_args(["--backend", "nccl"]))

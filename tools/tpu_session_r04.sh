@@ -55,7 +55,7 @@ log "all rc=$?"
 #    20 epochs multifactor, scheduled LR, fused device-resident epoch path
 timeout -k 10 2400 python -m tpu_dist.cli.train \
   --dataset synthetic_multifactor --model resnet18 --num_classes 16 \
-  --batch_size 256 --epochs 20 --lr 0.8 --lr_milestones 10 15 --lr_gamma 0.1 \
+  --batch_size 256 --epochs 20 --lr 0.4 --lr_milestones 10 15 --lr_gamma 0.1 \
   --synthetic_n 4096 --eval_every 5 --log_every 8 \
   --log_file "$OUT/TPU_RUN_r04.jsonl" > "$OUT/TPU_RUN_r04.log" 2>&1
 log "convergence run rc=$? tail: $(tail -2 "$OUT/TPU_RUN_r04.log" | tr '\n' ' ')"

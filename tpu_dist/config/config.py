@@ -250,10 +250,27 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     # accepted for command-line parity with torch.distributed.launch; unused
     p.add_argument("--local_rank", type=int, default=0, help=argparse.SUPPRESS)
     p.add_argument("--gpu", type=str, default=None, help=argparse.SUPPRESS)
+    # BASELINE.json north star names the switch `--backend=xla`: accept it.
+    # 'xla' is the only backend this framework has (collectives ride
+    # ICI/DCN through XLA); asking for nccl/gloo gets a pointed refusal
+    # rather than a silent ignore.
+    p.add_argument(
+        "--backend", choices=("xla", "nccl", "gloo", "mpi"), default="xla",
+        help="distributed backend; this framework is TPU-native, so 'xla' "
+             "is the only real choice (reference: init_process_group "
+             "backend, distributed.py:49)",
+    )
     return p
 
 
 def config_from_args(args: argparse.Namespace, **overrides) -> TrainConfig:
+    backend = getattr(args, "backend", "xla")
+    if backend != "xla":
+        raise SystemExit(
+            f"--backend {backend} is the reference's CUDA-world choice; this "
+            "framework runs XLA collectives over ICI/DCN and has no "
+            f"{backend} path — use --backend xla (the default)"
+        )
     fields = {f.name for f in dataclasses.fields(TrainConfig)}
     kw = {k: v for k, v in vars(args).items() if k in fields}
     if "lr_milestones" in kw:  # argparse nargs gives a list; config is a tuple
