@@ -1,0 +1,169 @@
+"""Sharded checkpointing (--sharded_ckpt): per-process shard files + a
+rank-0 manifest commit marker, NO gather at save time — the FSDP/ZeRO-
+scale format (ckpt/checkpoint.py::save_sharded)."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_dist.ckpt import checkpoint as ckpt_lib
+from tpu_dist.comm import mesh as mesh_lib
+from tpu_dist.config import TrainConfig
+from tpu_dist.train.optim import SGD
+from tpu_dist.train.state import TrainState
+from tpu_dist.train.trainer import Trainer, register_model
+from tests.helpers import TinyConvNet, tiny_resnet
+
+register_model("tiny_resnet_sc", lambda num_classes=10: tiny_resnet(num_classes))
+
+
+def _fsdp_like_state(mesh):
+    """Params/momentum sharded over the data axis (the ZeRO case sharded
+    ckpts exist for), BN replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    model = TinyConvNet(num_classes=10, width=16)
+    params, bn = model.init(jax.random.PRNGKey(0))
+    st = TrainState.create(params, bn, SGD())
+
+    def shard(x):
+        x = np.asarray(x)
+        if x.ndim and x.shape[0] % 8 == 0:
+            return jax.device_put(x, NamedSharding(mesh, P("data")))
+        return jax.device_put(x, NamedSharding(mesh, P()))
+
+    return TrainState(
+        params=jax.tree_util.tree_map(shard, st.params),
+        bn_state=jax.tree_util.tree_map(shard, st.bn_state),
+        opt_state=jax.tree_util.tree_map(shard, st.opt_state),
+        step=jax.device_put(st.step, NamedSharding(mesh, P())),
+    )
+
+
+def test_sharded_roundtrip_and_no_duplication(tmp_path):
+    mesh = mesh_lib.data_parallel_mesh()
+    state = _fsdp_like_state(mesh)
+    mpath = ckpt_lib.save_sharded(str(tmp_path), state, 3, extra_meta={"pp": 1})
+    assert mpath and mpath.endswith("ckpt_3.manifest.json")
+
+    found = ckpt_lib.latest_sharded_checkpoint(str(tmp_path))
+    assert found == (mpath, 3)
+    assert ckpt_lib.read_sharded_meta(mpath)["pp"] == 1
+
+    # single process -> one shard file; its bytes hold each distinct slice
+    # ONCE (replica_id dedup): total elements == state elements
+    shard_files = [n for n in os.listdir(tmp_path) if ".shard" in n]
+    assert shard_files == ["ckpt_3.shard0of1.npz"]
+    with np.load(tmp_path / shard_files[0]) as z:
+        stored = sum(int(np.prod(z[k].shape)) for k in z.files)
+    want = sum(
+        int(np.prod(np.shape(l)))
+        for l in jax.tree_util.tree_leaves(state._asdict())
+    )
+    assert stored == want, (stored, want)
+
+    template = _fsdp_like_state(mesh)
+    restored = ckpt_lib.restore_sharded(mpath, template)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state._asdict()),
+        jax.tree_util.tree_leaves(restored._asdict()),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_pruning_uncommits_manifest_first(tmp_path):
+    mesh = mesh_lib.data_parallel_mesh()
+    state = _fsdp_like_state(mesh)
+    for e in range(4):
+        ckpt_lib.save_sharded(str(tmp_path), state, e, keep_last=2)
+    names = sorted(os.listdir(tmp_path))
+    assert "ckpt_3.manifest.json" in names and "ckpt_2.manifest.json" in names
+    assert not any(n.startswith(("ckpt_0.", "ckpt_1.")) for n in names), names
+
+
+def test_sharded_incomplete_is_invisible_and_refused(tmp_path):
+    mesh = mesh_lib.data_parallel_mesh()
+    state = _fsdp_like_state(mesh)
+    mpath = ckpt_lib.save_sharded(str(tmp_path), state, 0)
+    # no manifest -> invisible to discovery
+    os.rename(mpath, str(tmp_path / "stash.json"))
+    assert ckpt_lib.latest_sharded_checkpoint(str(tmp_path)) is None
+    # manifest claiming more shards than exist -> loud refusal
+    man = json.load(open(tmp_path / "stash.json"))
+    man["n_shards"] = 2
+    with open(tmp_path / "ckpt_0.manifest.json", "w") as f:
+        json.dump(man, f)
+    with pytest.raises(FileNotFoundError, match="2 shard files"):
+        ckpt_lib.restore_sharded(
+            str(tmp_path / "ckpt_0.manifest.json"), _fsdp_like_state(mesh)
+        )
+
+
+def test_trainer_fsdp_sharded_ckpt_resume(tmp_path):
+    """e2e: FSDP trainer saves sharded, resumes from the manifest, params
+    match; async+sharded refused."""
+    cfg = TrainConfig(
+        dataset="synthetic", model="vit_tiny", num_classes=10, batch_size=64,
+        epochs=1, steps_per_epoch=2, eval_every=0, synthetic_n=640,
+        sync_bn=False, fsdp=True, sharded_ckpt=True,
+        ckpt_dir=str(tmp_path), save_every=1, log_every=10,
+    )
+    t = Trainer(cfg)
+    t.fit()
+    assert (tmp_path / "ckpt_0.manifest.json").exists()
+    assert (tmp_path / "ckpt_0.shard0of1.npz").exists()
+    assert not (tmp_path / "ckpt_0.npz").exists()  # no gathered file
+
+    t2 = Trainer(cfg.replace(resume=True, epochs=2))
+    assert t2.start_epoch == 1
+    for a, b in zip(
+        jax.tree_util.tree_leaves(t.state.params),
+        jax.tree_util.tree_leaves(t2.state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        Trainer(cfg.replace(async_ckpt=True))
+
+
+def test_best_save_uncommits_before_overwrite(tmp_path):
+    """save_best over an existing committed ckpt_best deletes the old
+    manifest BEFORE replacing shard files — a crash mid-overwrite leaves an
+    uncommitted (invisible) checkpoint, never a committed mixed one."""
+    mesh = mesh_lib.data_parallel_mesh()
+    s = _fsdp_like_state(mesh)
+    ckpt_lib.ShardedCheckpointer.save_best(str(tmp_path), s, 3, 71.5)
+    meta = ckpt_lib.read_sharded_meta(str(tmp_path / "ckpt_best.manifest.json"))
+    assert meta["metric"] == 71.5 and meta["epoch"] == 3
+    ckpt_lib.ShardedCheckpointer.save_best(str(tmp_path), s, 7, 82.0)
+    meta = ckpt_lib.read_sharded_meta(str(tmp_path / "ckpt_best.manifest.json"))
+    assert meta["metric"] == 82.0 and meta["epoch"] == 7
+
+
+def test_pruning_sweeps_orphaned_shards(tmp_path):
+    """Shard files whose epoch was never committed (crash before manifest)
+    are swept by the next keep_last pruning pass."""
+    mesh = mesh_lib.data_parallel_mesh()
+    s = _fsdp_like_state(mesh)
+    # fake a crashed epoch-0 save: shard file, no manifest
+    ckpt_lib.save_sharded(str(tmp_path), s, 0)
+    os.remove(tmp_path / "ckpt_0.manifest.json")
+    for e in (1, 2, 3):
+        ckpt_lib.save_sharded(str(tmp_path), s, e, keep_last=2)
+    names = os.listdir(tmp_path)
+    assert not any(n.startswith(("ckpt_0.", "ckpt_1.")) for n in names), names
+    assert any(n.startswith("ckpt_2.") for n in names)
+
+
+def test_resume_format_mismatch_is_loud(tmp_path):
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet_sc", num_classes=10,
+        batch_size=64, epochs=1, steps_per_epoch=2, eval_every=0,
+        synthetic_n=640, ckpt_dir=str(tmp_path), save_every=1, log_every=10,
+    )
+    Trainer(cfg).fit()  # plain-format checkpoints on disk
+    with pytest.raises(ValueError, match="plain format"):
+        Trainer(cfg.replace(resume=True, sharded_ckpt=True))

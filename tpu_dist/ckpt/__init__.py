@@ -1,8 +1,13 @@
 from tpu_dist.ckpt.checkpoint import (  # noqa: F401
     AsyncCheckpointer,
+    ShardedCheckpointer,
     latest_checkpoint,
+    latest_sharded_checkpoint,
     read_meta,
+    read_sharded_meta,
     restore,
+    restore_sharded,
     save,
     save_best,
+    save_sharded,
 )

@@ -104,6 +104,8 @@ class TrainConfig:
     grad_compression: str = "none" # none | bf16: gradient wire format for the
                                    # cross-replica reduce (DDP bf16_compress_hook
                                    # equivalent; halves grad ICI/DCN traffic)
+    sharded_ckpt: bool = False     # per-process shard files + rank-0 manifest;
+                                   # no gather at save time (FSDP/ZeRO scale)
 
     # -- bench / smoke / debug ---------------------------------------------
     steps_per_epoch: Optional[int] = None  # cap steps (smoke tests / benches)
@@ -232,6 +234,13 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--async_ckpt", action="store_true",
                    help="write checkpoints on a background thread (training "
                         "continues during the npz serialization)")
+    p.add_argument("--sharded_ckpt", action="store_true",
+                   help="sharded checkpoint format: every process writes only "
+                        "its own shard slices + a rank-0 manifest (commit "
+                        "marker) — no allgather at save time, the FSDP/ZeRO-"
+                        "scale choice; mutually exclusive with --async_ckpt "
+                        "(each process's write is already 1/n-sized, so the "
+                        "background-thread overlap buys little)")
     p.add_argument("--log_file", type=str, default=None,
                    help="JSONL metrics history path (rank 0)")
     p.add_argument("--tensorboard_dir", type=str, default=None,

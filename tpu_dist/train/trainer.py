@@ -124,6 +124,14 @@ class Trainer:
             num_processes=cfg.num_processes,
             process_id=cfg.process_id,
         )
+        if cfg.sharded_ckpt and cfg.async_ckpt:
+            raise ValueError(
+                "--sharded_ckpt and --async_ckpt are mutually exclusive by "
+                "design: sharding already makes each process's write "
+                "1/n-sized (the serialization the async thread exists to "
+                "overlap), and the manifest commit needs a cross-process "
+                "barrier that a background thread must not hold"
+            )
         if cfg.pp_interleave < 1:
             raise ValueError(f"pp_interleave must be >= 1, got {cfg.pp_interleave}")
         if cfg.pp_interleave > 1 and cfg.pp <= 1:
@@ -632,20 +640,47 @@ class Trainer:
         self._async_ckpt = None  # created lazily by _ckpt_io()
         self.start_epoch = 0
         if cfg.resume and cfg.ckpt_dir:
-            found = ckpt_lib.latest_checkpoint(cfg.ckpt_dir)
+            if cfg.sharded_ckpt:
+                find, read_meta_, restore_ = (
+                    ckpt_lib.latest_sharded_checkpoint,
+                    ckpt_lib.read_sharded_meta,
+                    ckpt_lib.restore_sharded,
+                )
+                other = ckpt_lib.latest_checkpoint
+            else:
+                find, read_meta_, restore_ = (
+                    ckpt_lib.latest_checkpoint,
+                    ckpt_lib.read_meta,
+                    ckpt_lib.restore,
+                )
+                other = ckpt_lib.latest_sharded_checkpoint
+            found = find(cfg.ckpt_dir)
+            if not found and other(cfg.ckpt_dir):
+                # silent restart-from-scratch is the one unacceptable outcome
+                raise ValueError(
+                    f"ckpt_dir {cfg.ckpt_dir} holds checkpoints in the "
+                    f"{'plain' if cfg.sharded_ckpt else 'sharded'} format "
+                    f"but this run asked for the "
+                    f"{'sharded' if cfg.sharded_ckpt else 'plain'} one — "
+                    "flip --sharded_ckpt to match (the formats do not "
+                    "auto-convert)"
+                )
             if found:
                 path, epoch = found
-                self._check_ckpt_layout(path)
+                self._check_ckpt_meta(read_meta_(path), path)
                 # template = current state (matches sharded layouts too)
-                restored = ckpt_lib.restore(path, self.state)
+                restored = restore_(path, self.state)
                 self.state = self._place_state(restored)
                 self.start_epoch = epoch + 1
                 rank0_print(f"=> resumed from {path} (epoch {epoch})")
 
     def _ckpt_io(self):
-        """Sync module functions or the async writer (``--async_ckpt``);
-        the writer is created lazily so each ``fit()`` gets a fresh pool
-        after ``_ckpt_close()`` released the previous worker thread."""
+        """Sync module functions, the sharded writer (``--sharded_ckpt``),
+        or the async writer (``--async_ckpt``); the async writer is created
+        lazily so each ``fit()`` gets a fresh pool after ``_ckpt_close()``
+        released the previous worker thread."""
+        if self.cfg.sharded_ckpt:
+            return ckpt_lib.ShardedCheckpointer()
         if not self.cfg.async_ckpt:
             return ckpt_lib
         if self._async_ckpt is None:
@@ -719,8 +754,10 @@ class Trainer:
         return meta
 
     def _check_ckpt_layout(self, path: str) -> None:
+        self._check_ckpt_meta(ckpt_lib.read_meta(path), path)
+
+    def _check_ckpt_meta(self, meta: dict, path: str) -> None:
         cfg = self.cfg
-        meta = ckpt_lib.read_meta(path)
         ck_v = meta.get("pp_interleave")
         ck_pp = meta.get("pp")
         if ck_v is None:
@@ -980,19 +1017,27 @@ class Trainer:
         # the LAST file published, and a writer error must not abort the
         # snapshot or mask the interrupt
         self._ckpt_close(suppress=True)
-        if jax.process_count() > 1 and any(
-            isinstance(l, jax.Array) and not l.is_fully_addressable
-            for l in jax.tree_util.tree_leaves(self.state._asdict())
+        if jax.process_count() > 1 and (
+            cfg.sharded_ckpt  # manifest commit needs a cross-process barrier
+            or any(
+                isinstance(l, jax.Array) and not l.is_fully_addressable
+                for l in jax.tree_util.tree_leaves(self.state._asdict())
+            )
         ):
             rank0_print(
-                "=> interrupted; state is sharded across processes — emergency "
-                "snapshot skipped (collective save cannot run from a signal "
-                "handler); resume from the last periodic checkpoint"
+                "=> interrupted; state (or the sharded-ckpt commit barrier) "
+                "is cross-process — emergency snapshot skipped (collectives "
+                "cannot run from a signal handler); resume from the last "
+                "periodic checkpoint"
             )
             return
+        io = ckpt_lib.ShardedCheckpointer if cfg.sharded_ckpt else ckpt_lib
+        done_marker = (
+            "ckpt_{e}.manifest.json" if cfg.sharded_ckpt else "ckpt_{e}.npz"
+        )
         if not self._in_epoch:
-            ckpt_lib.save(cfg.ckpt_dir, self.state, self._last_epoch,
-                          cfg.keep_last_ckpts, extra_meta=self._ckpt_meta())
+            io.save(cfg.ckpt_dir, self.state, self._last_epoch,
+                    cfg.keep_last_ckpts, extra_meta=self._ckpt_meta())
             rank0_print(
                 f"=> interrupted after epoch {self._last_epoch} completed; "
                 f"saved as epoch {self._last_epoch}"
@@ -1003,14 +1048,14 @@ class Trainer:
         prev = self._last_epoch - 1
         import os  # noqa: PLC0415
 
-        if os.path.exists(os.path.join(cfg.ckpt_dir, f"ckpt_{prev}.npz")):
+        if os.path.exists(os.path.join(cfg.ckpt_dir, done_marker.format(e=prev))):
             rank0_print(
                 f"=> interrupted mid-epoch {self._last_epoch}; clean ckpt_{prev} "
                 f"already on disk — kept as-is, resume re-runs epoch {self._last_epoch}"
             )
             return
-        ckpt_lib.save(cfg.ckpt_dir, self.state, prev, cfg.keep_last_ckpts,
-                      extra_meta=self._ckpt_meta())
+        io.save(cfg.ckpt_dir, self.state, prev, cfg.keep_last_ckpts,
+                extra_meta=self._ckpt_meta())
         rank0_print(
             f"=> interrupted mid-epoch {self._last_epoch}; state saved to "
             f"{cfg.ckpt_dir} as epoch {prev} — resume re-runs epoch "
