@@ -1,0 +1,102 @@
+"""Worker for the multi-host sharded-checkpoint test.
+
+2 processes × 4 CPU devices, one [data=8] global mesh, params/momentum
+sharded P('data') (the ZeRO case). Each process must write ONLY its own
+shard file (no gather — the point of the format), the manifest commits
+on rank 0, and a cross-process restore must hand every process exactly
+its local partition back.
+
+Usage: python tests/_mp_worker_ckpt.py <coordinator> <num_procs> <proc_id> <ckpt_dir>
+"""
+
+import os
+import sys
+
+if __name__ == "__main__":
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+import jax  # noqa: E402
+
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def run_ckpt_roundtrip(ckpt_dir: str):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_dist.ckpt import checkpoint as ckpt_lib
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.train.optim import SGD
+    from tpu_dist.train.state import TrainState
+
+    mesh = mesh_lib.device_mesh([jax.device_count()], ["data"])
+    rng = np.random.default_rng(7)
+    host_params = {
+        "w": rng.normal(size=(16, 8)).astype(np.float32),   # sharded P('data')
+        "b": rng.normal(size=(8,)).astype(np.float32),      # replicated
+    }
+
+    def place(x, spec):
+        return mesh_lib.place_host_tree(mesh, x, spec)
+
+    params = {
+        "w": place(host_params["w"], P("data")),
+        "b": place(host_params["b"], P()),
+    }
+    opt = SGD()
+    momentum = {
+        "w": place(np.zeros_like(host_params["w"]), P("data")),
+        "b": place(np.zeros_like(host_params["b"]), P()),
+    }
+    state = TrainState(
+        params=params,
+        bn_state={},
+        opt_state=momentum,
+        step=place(np.asarray(3, np.int32), P()),
+    )
+    mpath = ckpt_lib.save_sharded(ckpt_dir, state, 5, extra_meta={"pp": 1})
+
+    # every process sees the committed manifest on the shared fs
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("saved")
+    manifest = os.path.join(ckpt_dir, "ckpt_5.manifest.json")
+    assert os.path.exists(manifest), "manifest missing after commit"
+
+    # each process's shard file holds ONLY its local rows of w (8 of 16)
+    pid = jax.process_index()
+    with np.load(os.path.join(ckpt_dir, f"ckpt_5.shard{pid}of2.npz")) as z:
+        w_keys = [k for k in z.files if k.startswith("['params']['w']")]
+        local_w_rows = sum(z[k].shape[0] for k in w_keys)
+    assert local_w_rows == 8, (pid, local_w_rows)
+
+    restored = ckpt_lib.restore_sharded(manifest, state)
+    # the restored global array equals the original on every process
+    got = np.asarray(
+        multihost_utils.process_allgather(restored.params["w"], tiled=True)
+    )
+    np.testing.assert_array_equal(got, host_params["w"])
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["b"].addressable_shards[0].data),
+        host_params["b"],
+    )
+    assert int(np.asarray(restored.step.addressable_shards[0].data)) == 3
+    assert ckpt_lib.read_sharded_meta(manifest)["pp"] == 1
+    return float(got.sum())
+
+
+def main(coordinator: str, num_procs: int, proc_id: int, ckpt_dir: str) -> None:
+    from tpu_dist.comm import mesh as mesh_lib
+
+    mesh_lib.initialize_distributed(coordinator, num_procs, proc_id)
+    assert jax.process_count() == num_procs
+    fp = run_ckpt_roundtrip(ckpt_dir)
+    print(f"CKRESULT {proc_id} {fp:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])

@@ -21,7 +21,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch_workers(worker_script: str, result_prefix: str, nprocs: int = 2):
+def _launch_workers(worker_script: str, result_prefix: str, nprocs: int = 2,
+                    extra_args: tuple = ()):
     """Fan out ``worker_script`` over ``nprocs`` rendezvoused processes and
     parse its ``<result_prefix> <pid> <fields...>`` lines.
 
@@ -39,7 +40,7 @@ def _launch_workers(worker_script: str, result_prefix: str, nprocs: int = 2):
 
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, coord, str(nprocs), str(i)],
+            [sys.executable, worker, coord, str(nprocs), str(i), *extra_args],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=_REPO_ROOT,
         )
@@ -128,3 +129,24 @@ def test_two_process_ring_flash_sp_matches_single_process():
     loss, fp = (float(v) for v in results["0"])
     assert abs(loss - ref_loss) < 1e-4, (loss, ref_loss)
     assert abs(fp - ref_fp) < 1e-3, (fp, ref_fp)
+
+
+def test_two_process_sharded_ckpt_no_gather(tmp_path):
+    """2 hosts × 4 devices, params P('data') over the global mesh: each
+    process writes ONLY its own 1/2 of the sharded leaves (byte-checked in
+    the worker — the no-gather-at-save property), the rank-0 manifest
+    commits, and a cross-process overlap-only restore hands every process
+    its partition back, equal to the original values."""
+    results, _ = _launch_workers(
+        "_mp_worker_ckpt.py", "CKRESULT", extra_args=(str(tmp_path),)
+    )
+    assert results["0"] == results["1"], results
+    # exactly two shard files + one manifest on the shared dir
+    import os as _os
+
+    names = sorted(_os.listdir(tmp_path))
+    assert names == [
+        "ckpt_5.manifest.json",
+        "ckpt_5.shard0of2.npz",
+        "ckpt_5.shard1of2.npz",
+    ], names

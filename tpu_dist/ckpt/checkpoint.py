@@ -26,6 +26,15 @@ from tpu_dist.train.state import TrainState
 _CKPT_RE = re.compile(r"ckpt_(\d+)\.npz$")
 
 
+def _scalar_to_host(x):
+    """Host value of a (possibly process-spanning, replicated) scalar leaf:
+    the local addressable shard holds it — no collective, no device_get on
+    a global array (which raises across processes)."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        return np.asarray(x.addressable_shards[0].data)
+    return np.asarray(jax.device_get(x))
+
+
 def _leaf_to_host(leaf) -> np.ndarray:
     """Bring one leaf fully to host. Leaves sharded across processes (ZeRO-1
     opt state under P('data'), TP-sharded params on a multi-host mesh) are
@@ -107,7 +116,7 @@ def save(
     flat = _flatten(state._asdict())
     if jax.process_index() != 0:
         return None
-    meta = {"epoch": epoch, "step": int(jax.device_get(state.step))}
+    meta = {"epoch": epoch, "step": int(_scalar_to_host(state.step))}
     if extra_meta:
         meta.update(extra_meta)
     return _write_npz(ckpt_dir, f"ckpt_{epoch}.npz", flat, meta, keep_last)
@@ -198,7 +207,7 @@ class AsyncCheckpointer:
         if jax.process_index() != 0:
             return None
         self._harvest(block=False)  # surface finished writes' errors only
-        meta = {"epoch": epoch, "step": int(jax.device_get(state.step))}
+        meta = {"epoch": epoch, "step": int(_scalar_to_host(state.step))}
         if extra_meta:
             meta.update(extra_meta)
         self._pending.append(self._pool.submit(
@@ -383,7 +392,7 @@ def save_sharded(
         multihost_utils.sync_global_devices(f"ckpt_commit_{stem}")
     if pid != 0:
         return None
-    meta = {"epoch": epoch, "step": int(jax.device_get(state.step))}
+    meta = {"epoch": epoch, "step": int(_scalar_to_host(state.step))}
     if extra_meta:
         meta.update(extra_meta)
     manifest = {"meta": meta, "n_shards": nproc, "shapes": shapes}
@@ -524,7 +533,9 @@ def restore_sharded(manifest_path: str, template: TrainState) -> TrainState:
             if key not in pieces:
                 raise KeyError(f"checkpoint missing array for {key}")
             gshape = tuple(shapes[key])
-            dtype = np.dtype(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+            dtype = np.dtype(
+                leaf.dtype if hasattr(leaf, "dtype") else np.asarray(leaf).dtype
+            )
             if tuple(np.shape(leaf)) != gshape:
                 raise ValueError(
                     f"shape mismatch for {key}: ckpt {gshape} vs state "
