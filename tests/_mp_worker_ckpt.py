@@ -26,11 +26,10 @@ import numpy as np  # noqa: E402
 
 
 def run_ckpt_roundtrip(ckpt_dir: str):
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from tpu_dist.ckpt import checkpoint as ckpt_lib
     from tpu_dist.comm import mesh as mesh_lib
-    from tpu_dist.train.optim import SGD
     from tpu_dist.train.state import TrainState
 
     mesh = mesh_lib.device_mesh([jax.device_count()], ["data"])
@@ -47,7 +46,6 @@ def run_ckpt_roundtrip(ckpt_dir: str):
         "w": place(host_params["w"], P("data")),
         "b": place(host_params["b"], P()),
     }
-    opt = SGD()
     momentum = {
         "w": place(np.zeros_like(host_params["w"]), P("data")),
         "b": place(np.zeros_like(host_params["b"]), P()),
@@ -58,7 +56,7 @@ def run_ckpt_roundtrip(ckpt_dir: str):
         opt_state=momentum,
         step=place(np.asarray(3, np.int32), P()),
     )
-    mpath = ckpt_lib.save_sharded(ckpt_dir, state, 5, extra_meta={"pp": 1})
+    ckpt_lib.save_sharded(ckpt_dir, state, 5, extra_meta={"pp": 1})
 
     # every process sees the committed manifest on the shared fs
     from jax.experimental import multihost_utils

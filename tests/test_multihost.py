@@ -142,9 +142,7 @@ def test_two_process_sharded_ckpt_no_gather(tmp_path):
     )
     assert results["0"] == results["1"], results
     # exactly two shard files + one manifest on the shared dir
-    import os as _os
-
-    names = sorted(_os.listdir(tmp_path))
+    names = sorted(os.listdir(tmp_path))
     assert names == [
         "ckpt_5.manifest.json",
         "ckpt_5.shard0of2.npz",
