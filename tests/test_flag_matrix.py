@@ -36,12 +36,16 @@ PARALLEL_COMBOS = [
     dict(model="vit_tiny", tp=4, grad_accu_steps=2, sync_bn=False, batch_size=32),
     dict(model="vit_moe_tiny", ep=4, grad_accu_steps=2, sync_bn=False, batch_size=32),
     dict(model="vit_tiny", sp=4, bf16=True, remat=True, sync_bn=False, batch_size=32),
+    dict(model="vit_tiny", sp=4, grad_compression="bf16", sync_bn=False,
+         batch_size=32),
+    dict(model="vit_moe_tiny", ep=4, grad_compression="bf16", sync_bn=False,
+         batch_size=32),
 ]
 
 
 @pytest.mark.parametrize(
     "combo", PARALLEL_COMBOS,
-    ids=["sp+ga", "tp+ga", "ep+ga", "sp+bf16+remat"],
+    ids=["sp+ga", "tp+ga", "ep+ga", "sp+bf16+remat", "sp+gradcomp", "ep+gradcomp"],
 )
 def test_parallel_axes_compose_with_accum(combo):
     cfg = TrainConfig(
