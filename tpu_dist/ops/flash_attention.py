@@ -127,9 +127,10 @@ def _fwd(q3, k3, v3, causal, block_q, block_k, interpret, out_dtype=None):
     n_k = kp.shape[1] // bk
     scale = 1.0 / float(d) ** 0.5
 
+    odt = out_dtype or q3.dtype
     kern = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        kv_len=s_kv, out_dtype=out_dtype or q3.dtype,
+        kv_len=s_kv, out_dtype=odt,
     )
     mem = {"memory_space": pltpu.VMEM}
     out, m, l = pl.pallas_call(
@@ -146,7 +147,7 @@ def _fwd(q3, k3, v3, causal, block_q, block_k, interpret, out_dtype=None):
             pl.BlockSpec((1, bq), lambda b, i, j: (b, i), **mem),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(qp.shape, out_dtype or q3.dtype),
+            jax.ShapeDtypeStruct(qp.shape, odt),
             jax.ShapeDtypeStruct(qp.shape[:2], jnp.float32),
             jax.ShapeDtypeStruct(qp.shape[:2], jnp.float32),
         ],

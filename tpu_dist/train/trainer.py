@@ -680,7 +680,9 @@ class Trainer:
         lazily so each ``fit()`` gets a fresh pool after ``_ckpt_close()``
         released the previous worker thread."""
         if self.cfg.sharded_ckpt:
-            return ckpt_lib.ShardedCheckpointer()
+            # stateless (staticmethods) — hand back the class, same as the
+            # emergency-save path uses it
+            return ckpt_lib.ShardedCheckpointer
         if not self.cfg.async_ckpt:
             return ckpt_lib
         if self._async_ckpt is None:
