@@ -87,3 +87,84 @@ def test_no_nan_guard_cli_flag():
     cfg = config_from_args(p.parse_args(["--no_nan_guard"]))
     assert cfg.nan_guard is False
     assert config_from_args(p.parse_args([])).nan_guard is True
+
+
+def test_auto_recover_reloads_and_backs_off(tmp_path):
+    """--auto_recover: epoch 0 trains and checkpoints at lr=0.1, the
+    milestone then multiplies LR by 1e13 and epoch 1 diverges; recovery
+    reloads ckpt_0 and rescales the schedule (factor 1e-13 -> back to
+    ~0.1), and the run completes with finite loss. The JSONL history
+    records the recovery."""
+    import json
+
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet_g", num_classes=10,
+        batch_size=64, epochs=3, steps_per_epoch=3, log_every=1,
+        lr=0.1, lr_milestones=(1,), lr_gamma=1e13, eval_every=0,
+        ckpt_dir=str(tmp_path), save_every=1,
+        auto_recover=1, recover_lr_factor=1e-13,
+        log_file=str(tmp_path / "h.jsonl"),
+    )
+    t = Trainer(cfg)
+    out = t.fit()
+    assert np.isfinite(out["loss"]), out
+    assert t._lr_scale == 1e-13
+    events = [json.loads(l) for l in open(tmp_path / "h.jsonl")]
+    assert any(e.get("kind") == "auto_recover" for e in events), events
+
+
+def test_auto_recover_exhausted_reraises(tmp_path):
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet_g", num_classes=10,
+        batch_size=64, epochs=3, steps_per_epoch=3, log_every=1,
+        lr=0.1, lr_milestones=(1,), lr_gamma=1e13, eval_every=0,
+        ckpt_dir=str(tmp_path), save_every=1,
+        auto_recover=2, recover_lr_factor=0.5,  # 5e11x is still a blow-up
+    )
+    with pytest.raises(TrainingDivergedError):
+        Trainer(cfg).fit()
+
+
+def test_auto_recover_without_ckpt_reraises(tmp_path):
+    # divergence in epoch 0, nothing saved yet: nothing to recover FROM
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet_g", num_classes=10,
+        batch_size=64, epochs=1, steps_per_epoch=3, log_every=1,
+        lr=1e12, eval_every=0, ckpt_dir=str(tmp_path), save_every=1,
+        auto_recover=3,
+    )
+    with pytest.raises(TrainingDivergedError):
+        Trainer(cfg).fit()
+
+
+def test_auto_recover_scale_survives_resume(tmp_path):
+    """The backoff is stamped into checkpoint meta: a --resume after a
+    recovered run continues with the SCALED schedule instead of replaying
+    the divergence (code-review r4)."""
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet_g", num_classes=10,
+        batch_size=64, epochs=3, steps_per_epoch=3, log_every=1,
+        lr=0.1, lr_milestones=(1,), lr_gamma=1e13, eval_every=0,
+        ckpt_dir=str(tmp_path), save_every=1,
+        auto_recover=1, recover_lr_factor=1e-13,
+    )
+    t = Trainer(cfg)
+    t.fit()
+    assert t._lr_scale == 1e-13
+    t2 = Trainer(cfg.replace(resume=True, epochs=4))
+    assert t2._lr_scale == 1e-13  # picked up from ckpt meta, not reset
+
+
+def test_emergency_save_refuses_poisoned_state(tmp_path):
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet_g", num_classes=10,
+        batch_size=64, epochs=1, steps_per_epoch=2, log_every=1,
+        eval_every=0, ckpt_dir=str(tmp_path), save_every=1,
+    )
+    t = Trainer(cfg)
+    t._last_epoch, t._in_epoch = 1, False
+    t._state_poisoned = True  # the divergence-handling window
+    t._emergency_save()
+    import os
+
+    assert os.listdir(tmp_path) == []  # nothing written

@@ -112,6 +112,9 @@ class TrainConfig:
     debug_replica_check: bool = False  # assert params replicated each epoch
     profile_dir: Optional[str] = None  # capture an XLA trace of epoch 0
     nan_guard: bool = True         # raise TrainingDivergedError on NaN loss
+    auto_recover: int = 0          # divergence responses: reload last ckpt +
+                                   # LR backoff, up to N times (0 = just raise)
+    recover_lr_factor: float = 0.5 # schedule scale applied per recovery
     compile_cache_dir: Optional[str] = None  # persistent XLA compile cache:
                                    # repeat invocations of the same config
                                    # skip the cold first-compile. NOTE:
@@ -193,6 +196,14 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--no_sync_bn", dest="sync_bn", action="store_false",
                    help="per-replica BatchNorm statistics (SyncBN off)")
     p.add_argument("--no_nan_guard", dest="nan_guard", action="store_false")
+    p.add_argument("--auto_recover", type=int, default=d.auto_recover,
+                   metavar="N",
+                   help="on divergence (NaN guard), reload the last "
+                        "checkpoint and retry with the LR schedule scaled "
+                        "by --recover_lr_factor, up to N times — a bare "
+                        "retry would diverge identically (deterministic "
+                        "epoch-seeded data order)")
+    p.add_argument("--recover_lr_factor", type=float, default=d.recover_lr_factor)
     p.add_argument("--dataset", type=str, default=d.dataset,
                    help="cifar100 | cifar10 | synthetic")
     p.add_argument("--data_dir", type=str, default=d.data_dir)

@@ -559,6 +559,12 @@ class Trainer:
         # place on the mesh (DDP's init-time param broadcast; sharded
         # placements for TP params / ZeRO-1 optimizer state)
         self.state = self._place_state(state)
+        # auto-recovery LR backoff: deterministic data order means a bare
+        # retry of a diverged epoch would diverge identically — each
+        # recovery scales the schedule down (cfg.recover_lr_factor)
+        self._lr_scale = 1.0
+        self._state_poisoned = False
+        self._best_top1 = -1.0
         if cfg.lr_schedule == "cosine":
             self.lr_schedule = cosine_lr(cfg.lr, cfg.epochs, cfg.warmup_epochs)
         else:
@@ -640,39 +646,11 @@ class Trainer:
         self._async_ckpt = None  # created lazily by _ckpt_io()
         self.start_epoch = 0
         if cfg.resume and cfg.ckpt_dir:
-            if cfg.sharded_ckpt:
-                find, read_meta_, restore_ = (
-                    ckpt_lib.latest_sharded_checkpoint,
-                    ckpt_lib.read_sharded_meta,
-                    ckpt_lib.restore_sharded,
-                )
-                other = ckpt_lib.latest_checkpoint
-            else:
-                find, read_meta_, restore_ = (
-                    ckpt_lib.latest_checkpoint,
-                    ckpt_lib.read_meta,
-                    ckpt_lib.restore,
-                )
-                other = ckpt_lib.latest_sharded_checkpoint
-            found = find(cfg.ckpt_dir)
-            if not found and other(cfg.ckpt_dir):
-                # silent restart-from-scratch is the one unacceptable outcome
-                raise ValueError(
-                    f"ckpt_dir {cfg.ckpt_dir} holds checkpoints in the "
-                    f"{'plain' if cfg.sharded_ckpt else 'sharded'} format "
-                    f"but this run asked for the "
-                    f"{'sharded' if cfg.sharded_ckpt else 'plain'} one — "
-                    "flip --sharded_ckpt to match (the formats do not "
-                    "auto-convert)"
-                )
-            if found:
-                path, epoch = found
-                self._check_ckpt_meta(read_meta_(path), path)
-                # template = current state (matches sharded layouts too)
-                restored = restore_(path, self.state)
-                self.state = self._place_state(restored)
+            # template = current state (matches sharded layouts too);
+            # raises on a format-mismatched ckpt_dir (_restore_latest)
+            epoch = self._restore_latest()
+            if epoch is not None:
                 self.start_epoch = epoch + 1
-                rank0_print(f"=> resumed from {path} (epoch {epoch})")
 
     def _ckpt_io(self):
         """Sync module functions, the sharded writer (``--sharded_ckpt``),
@@ -753,6 +731,10 @@ class Trainer:
         meta = {"pp": cfg.pp, "pp_interleave": cfg.pp_interleave}
         if cfg.optimizer == "adamw":
             meta["adamw_decay_mask"] = cfg.adamw_decay_mask
+        if self._lr_scale != 1.0:
+            # auto-recovery backoff survives preemption: a --resume that
+            # replayed the UNSCALED schedule would re-diverge identically
+            meta["lr_scale"] = self._lr_scale
         return meta
 
     def _check_ckpt_layout(self, path: str) -> None:
@@ -890,7 +872,7 @@ class Trainer:
             return self._train_epoch_fused(epoch)
         cfg = self.cfg
         self.train_sampler.set_epoch(epoch)  # shuffle correctness (tutorials/2:§2)
-        lr = self.lr_schedule(epoch)
+        lr = self._lr(epoch)
         losses = AverageMeter("Loss", ":.4e")  # epoch-avg of the logged steps
         images_seen = 0
         t0 = time.time()
@@ -943,7 +925,7 @@ class Trainer:
     def _train_epoch_fused(self, epoch: int) -> dict:
         """One jit call for the whole epoch (tpu_dist/train/epoch.py)."""
         cfg = self.cfg
-        lr = self.lr_schedule(epoch)
+        lr = self._lr(epoch)
         t0 = time.time()
         self.state, metrics = self._fused_runner(
             self.state, *self._fused_data, lr, epoch
@@ -965,6 +947,73 @@ class Trainer:
         m.update(epoch_time=dt, images_per_sec=ips)
         return m
 
+    def _lr(self, epoch: int) -> float:
+        """Scheduled LR times the auto-recovery backoff scale."""
+        return self.lr_schedule(epoch) * self._lr_scale
+
+    def _restore_latest(self):
+        """Restore the newest checkpoint in the configured format.
+        Returns its epoch, or None when the dir holds nothing; raises when
+        the dir holds only the OTHER format (a silent restart-from-scratch
+        is the one unacceptable outcome)."""
+        cfg = self.cfg
+        if not cfg.ckpt_dir:
+            return None
+        if cfg.sharded_ckpt:
+            find, read_meta_, restore_ = (
+                ckpt_lib.latest_sharded_checkpoint,
+                ckpt_lib.read_sharded_meta,
+                ckpt_lib.restore_sharded,
+            )
+            other = ckpt_lib.latest_checkpoint
+        else:
+            find, read_meta_, restore_ = (
+                ckpt_lib.latest_checkpoint,
+                ckpt_lib.read_meta,
+                ckpt_lib.restore,
+            )
+            other = ckpt_lib.latest_sharded_checkpoint
+        found = find(cfg.ckpt_dir)
+        if not found:
+            if other(cfg.ckpt_dir):
+                raise ValueError(
+                    f"ckpt_dir {cfg.ckpt_dir} holds checkpoints in the "
+                    f"{'plain' if cfg.sharded_ckpt else 'sharded'} format "
+                    f"but this run asked for the "
+                    f"{'sharded' if cfg.sharded_ckpt else 'plain'} one — "
+                    "flip --sharded_ckpt to match (the formats do not "
+                    "auto-convert)"
+                )
+            return None
+        path, epoch = found
+        meta = read_meta_(path)
+        self._check_ckpt_meta(meta, path)
+        restored = restore_(path, self.state)
+        self.state = self._place_state(restored)
+        # pick the recovery backoff up from the checkpoint (see _ckpt_meta)
+        self._lr_scale = float(meta.get("lr_scale", 1.0))
+        self._state_poisoned = False
+        rank0_print(f"=> resumed from {path} (epoch {epoch})")
+        return epoch
+
+    def _auto_recover(self, err: TrainingDivergedError) -> None:
+        """Divergence response (--auto_recover): reload the last good
+        checkpoint and back the LR schedule off by cfg.recover_lr_factor —
+        a bare retry would diverge identically (epoch-seeded data order is
+        deterministic by design). Raises the original error when there is
+        no checkpoint to fall back to."""
+        cfg = self.cfg
+        self._ckpt_close(suppress=True)  # drain in-flight async writes
+        epoch = self._restore_latest()
+        if epoch is None:
+            raise err
+        self.start_epoch = epoch + 1
+        self._lr_scale *= cfg.recover_lr_factor
+        rank0_print(
+            f"=> AUTO-RECOVER: {err}; resumed from epoch {epoch}, LR scale "
+            f"now {self._lr_scale:g} (factor {cfg.recover_lr_factor})"
+        )
+
     def fit(self, epochs: Optional[int] = None) -> dict:
         cfg = self.cfg
         epochs = epochs if epochs is not None else cfg.epochs
@@ -979,10 +1028,26 @@ class Trainer:
             from tpu_dist.metrics.tensorboard import SummaryWriter  # noqa: PLC0415
 
             self._tb = SummaryWriter(cfg.tensorboard_dir)
+        attempts = cfg.auto_recover
+        self._best_top1 = -1.0  # survives recovery retries of _fit_loop
         try:
-            result = self._fit_loop(epochs, history, last)
-            self._ckpt_close()  # success path: writer errors RAISE here
-            return result
+            while True:
+                try:
+                    result = self._fit_loop(epochs, history, last)
+                    self._ckpt_close()  # success path: writer errors RAISE
+                    return result
+                except TrainingDivergedError as e:
+                    # from here until the restore completes, self.state is
+                    # NaN-poisoned — _emergency_save must not snapshot it
+                    self._state_poisoned = True
+                    if attempts <= 0:
+                        raise
+                    attempts -= 1
+                    self._auto_recover(e)  # raises e when no ckpt to load
+                    history.log(
+                        "auto_recover", epoch=self._last_epoch,
+                        lr_scale=self._lr_scale,
+                    )
         except KeyboardInterrupt:
             self._emergency_save()
             raise
@@ -1013,6 +1078,13 @@ class Trainer:
         """
         cfg = self.cfg
         if not cfg.ckpt_dir:
+            return
+        if getattr(self, "_state_poisoned", False):
+            rank0_print(
+                "=> interrupted while the live state was NaN-poisoned "
+                "(divergence handling in flight) — emergency snapshot "
+                "skipped; the last periodic checkpoint stays the newest"
+            )
             return
         # drain any in-flight async write FIRST (host-local, not collective —
         # safe before the sharded-state guard): the emergency snapshot must be
@@ -1066,7 +1138,6 @@ class Trainer:
 
     def _fit_loop(self, epochs: int, history, last: dict) -> dict:
         cfg = self.cfg
-        best_top1 = -1.0
         for epoch in range(self.start_epoch, epochs):
             self._last_epoch = epoch
             self._in_epoch = True  # _emergency_save: mid-epoch vs between
@@ -1083,7 +1154,7 @@ class Trainer:
                 for k in ("loss", "acc1", "acc5", "images_per_sec"):
                     if k in last:
                         self._tb.add_scalar(f"train/{k}", last[k], epoch)
-                self._tb.add_scalar("train/lr", self.lr_schedule(epoch), epoch)
+                self._tb.add_scalar("train/lr", self._lr(epoch), epoch)
             if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
                 if self._fused_runner is not None:
                     sums = {
@@ -1105,8 +1176,8 @@ class Trainer:
                     self._tb.add_scalar("eval/top1", t1, epoch)
                     self._tb.add_scalar("eval/top5", t5, epoch)
                     self._tb.add_scalar("eval/loss", vloss, epoch)
-                if cfg.ckpt_dir and t1 > best_top1:
-                    best_top1 = t1
+                if cfg.ckpt_dir and t1 > self._best_top1:
+                    self._best_top1 = t1
                     self._ckpt_io().save_best(
                         cfg.ckpt_dir, self.state, epoch, t1,
                         extra_meta=self._ckpt_meta(),
