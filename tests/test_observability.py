@@ -57,3 +57,43 @@ def test_check_replicated_detects_divergence():
     )
     with pytest.raises(AssertionError, match="replica divergence"):
         check_replicated({"w": arr}, name="params")
+
+
+def test_trainer_profile_dir_captures_trace(tmp_path):
+    """--profile_dir wraps epoch 0 in the XLA profiler (metrics/profiler.py):
+    a TensorBoard-readable xplane capture must land on disk."""
+    from tests.helpers import tiny_resnet
+    from tpu_dist.config import TrainConfig
+    from tpu_dist.train.trainer import Trainer, register_model
+
+    register_model("tiny_resnet_obs2", lambda num_classes=10: tiny_resnet(num_classes))
+    prof = tmp_path / "prof"
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_resnet_obs2", num_classes=10,
+        batch_size=64, epochs=1, steps_per_epoch=2, eval_every=0,
+        synthetic_n=640, log_every=10, profile_dir=str(prof),
+    )
+    Trainer(cfg).fit()
+    captures = list(prof.rglob("*.xplane.pb"))
+    assert captures, f"no xplane capture under {prof}"
+
+
+def test_loader_num_workers_prefetch_depth():
+    """--num_workers maps to the loader's prefetch depth; training is
+    unaffected by its value (same batches, same order)."""
+    from tests.helpers import tiny_resnet
+    from tpu_dist.config import TrainConfig
+    from tpu_dist.train.trainer import Trainer, register_model
+
+    register_model("tiny_resnet_obs3", lambda num_classes=10: tiny_resnet(num_classes))
+    import numpy as np
+
+    outs = []
+    for nw in (1, 4):
+        cfg = TrainConfig(
+            dataset="synthetic", model="tiny_resnet_obs3", num_classes=10,
+            batch_size=64, epochs=1, steps_per_epoch=3, eval_every=0,
+            synthetic_n=640, log_every=10, num_workers=nw, seed=0,
+        )
+        outs.append(Trainer(cfg).train_epoch(0)["loss"])
+    assert np.isclose(outs[0], outs[1]), outs
