@@ -39,3 +39,32 @@ def test_fixed_seed_trajectory_reproduces():
         state, m = step(state, x, y, 0.1)
         losses.append(float(m["loss"]))
     np.testing.assert_allclose(losses, GOLDEN, rtol=2e-3)
+
+
+GOLDEN_ADAMW = [
+    2.412941, 2.409781, 2.406655, 2.403563, 2.400502,
+    2.397464, 2.394458, 2.391484, 2.388544, 2.385641,
+]
+
+
+def test_fixed_seed_adamw_trajectory_reproduces():
+    """Same guard for the AdamW stack (moments, bias correction, decoupled
+    decay + auto mask) — the SGD golden run covers none of it."""
+    from tpu_dist.train.optim import AdamW
+
+    mesh = mesh_lib.data_parallel_mesh()
+    model = TinyConvNet(num_classes=10, width=8)
+    opt = AdamW()
+    params, bn = model.init(jax.random.PRNGKey(42))
+    state = jax.device_put(
+        TrainState.create(params, bn, opt), mesh_lib.replicated(mesh)
+    )
+    step = make_train_step(model.apply, opt, mesh)
+    rng = np.random.default_rng(7)
+    x = mesh_lib.shard_batch(mesh, rng.normal(size=(64, 8, 8, 3)).astype(np.float32))
+    y = mesh_lib.shard_batch(mesh, rng.integers(0, 10, 64).astype(np.int32))
+    losses = []
+    for _ in range(10):
+        state, m = step(state, x, y, 0.001)
+        losses.append(float(m["loss"]))
+    np.testing.assert_allclose(losses, GOLDEN_ADAMW, rtol=2e-3)
