@@ -23,10 +23,14 @@ log "tunnel alive"
 timeout -k 10 1200 python bench.py > "$OUT/BENCH_DEFAULT.json" 2>"$OUT/bench_default.err"
 log "default bench rc=$? $(cat "$OUT/BENCH_DEFAULT.json" 2>/dev/null | head -c 300)"
 
-# 2. flash long-seq crossover (this round's kernel showcase)
+# 2. flash long-seq crossover (this round's kernel showcase), plus a
+#    causal row (the above-diagonal tile skip is measurable fwd+bwd)
 timeout -k 10 2400 python bench.py --attn_all --steps 30 --warmup 5 \
   > "$OUT/ATTN_ALL.json" 2>"$OUT/attn.err"
 log "attn_all rc=$?"
+timeout -k 10 1200 python bench.py --attn 4096 --causal --steps 30 --warmup 5 \
+  > "$OUT/ATTN_CAUSAL.json" 2>"$OUT/attn_causal.err"
+log "attn_causal rc=$?"
 
 # 3. ResNet-50 at b128 + s2d stem A/B (VERDICT #2)
 for cfg in resnet50_imagenet resnet50_imagenet_s2d; do
