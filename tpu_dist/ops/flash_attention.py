@@ -61,32 +61,40 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0].astype(jnp.float32)                      # [bq, d]
-    k = k_ref[0].astype(jnp.float32)                      # [bk, d]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale                                             # [bq, bk]
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)                  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)                  # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                         # [bq, bk]
 
-    k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    mask = k_pos < kv_len                                 # kv padding
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = k_pos < kv_len                             # kv padding
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]                             # [bq, 1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)                       # exact zeros
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
     if causal:
-        q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        mask = jnp.logical_and(mask, q_pos >= k_pos)
-    s = jnp.where(mask, s, _NEG_INF)
-
-    m_prev = m_scr[:, :1]                                 # [bq, 1]
-    l_prev = l_scr[:, :1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    corr = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)
-    p = jnp.where(mask, p, 0.0)                           # exact zeros
-    l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-        p, v_ref[0].astype(jnp.float32),
-        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
-    )
-    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        # tiles entirely above the diagonal are all-masked: p would be 0,
+        # m/l/acc unchanged — skip their matmuls (same guard as the bwd)
+        pl.when(_causal_block_live(i, j, block_q, block_k))(_accumulate)
+    else:
+        _accumulate()
 
     @pl.when(j == n_k - 1)
     def _finish():
@@ -202,8 +210,9 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, delta_ref,
 def _causal_block_live(i, j, block_q, block_k):
     """False iff the (q-block i, k-block j) tile lies entirely above the
     causal diagonal (max q_pos < min k_pos) — those tiles are all-masked,
-    so both backward kernels skip their matmuls (~2× fewer FLOPs at long
-    S; the accumulators simply don't change)."""
+    so all three kernels (forward, dK/dV, dQ) skip their matmuls (~2×
+    fewer FLOPs at long S; the running state provably doesn't change:
+    p would be exactly 0 and m_new == m_prev even at the _NEG_INF init)."""
     return (i + 1) * block_q - 1 >= j * block_k
 
 
