@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
@@ -272,19 +273,23 @@ def test_ring_flash_grads_match_full():
             )
 
 
-def test_ring_flash_bf16_matches_single_device_flash():
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_bf16_matches_single_device_flash(causal):
     """bf16 inputs (the TPU training dtype): per-rotation partials merge
     in f32 — the ring result must stay within ONE bf16 rounding of the
     single-device flash kernel, not accumulate a fresh quantization per
-    rotation."""
+    rotation.  causal=True is the advertised long-context training combo;
+    its backward hits the masked lax.switch branch, whose zero-grads must
+    carry the same f32 dtype as the kernel branches (advisor r4 finding)."""
     from tpu_dist.ops.flash_attention import flash_attention
 
     mesh = mesh_lib.device_mesh([4], ["seq"], jax.devices()[:4])
     q, k, v = (t.astype(jnp.bfloat16) for t in _qkv(s=64, seed=8))
-    fn = _ring_flash_fn(mesh, causal=False)
+    fn = _ring_flash_fn(mesh, causal=causal)
     out = np.asarray(fn(q, k, v), dtype=np.float32)
     ref = np.asarray(
-        flash_attention(q, k, v, block_q=16, block_k=16), dtype=np.float32
+        flash_attention(q, k, v, causal=causal, block_q=16, block_k=16),
+        dtype=np.float32,
     )
     # bf16 has ~2^-8 relative precision; one rounding of each is ~1.6e-2
     np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
@@ -302,9 +307,10 @@ def test_ring_flash_bf16_matches_single_device_flash():
         )(q, k, v)
 
     g_ring = g(fn)
-    g_ref = g(lambda q, k, v: flash_attention(q, k, v, block_q=16, block_k=16))
+    g_ref = g(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, block_q=16, block_k=16))
     for got, want, name in zip(g_ring, g_ref, "qkv"):
         np.testing.assert_allclose(
             np.asarray(got, dtype=np.float32), np.asarray(want, dtype=np.float32),
-            rtol=4e-2, atol=4e-2, err_msg=f"d{name} bf16",
+            rtol=4e-2, atol=4e-2, err_msg=f"d{name} bf16 causal={causal}",
         )

@@ -1,0 +1,98 @@
+#!/bin/bash
+# Round-5 TPU capture session: run ONCE when the tunnel recovers, in
+# decreasing order of VERDICT-r4 value. One TPU process at a time (each
+# bench/python run takes the machine lock; bench also waits --lock_wait).
+# Usage: bash tools/tpu_session_r05.sh [outdir]   (default /root/repo/tpu_r05)
+cd /root/repo || exit 2
+OUT=${1:-/root/repo/tpu_r05}
+mkdir -p "$OUT"
+log() { echo "$(date -u +%F_%T) $*" | tee -a "$OUT/session.log"; }
+
+# 0. single bounded probe — bail early if still wedged
+timeout -k 10 300 python - <<'PY' || { log "probe FAILED - tunnel still wedged"; exit 3; }
+from tpu_dist.comm import tpu_lock
+tpu_lock.guard_or_exit("r05_probe")
+import jax
+d = jax.devices()
+assert d and d[0].platform != "cpu", d
+print("ALIVE", d, flush=True)
+PY
+log "tunnel alive"
+
+# 1. driver-contract default line (also exercises the compile cache).
+#    On success, refresh LAST_GOOD_BENCH.json so the stale-fallback path
+#    serves this capture from now on.
+timeout -k 10 1200 python bench.py > "$OUT/BENCH_DEFAULT.json" 2>"$OUT/bench_default.err"
+rc=$?
+log "default bench rc=$rc $(head -c 300 "$OUT/BENCH_DEFAULT.json" 2>/dev/null)"
+if [ "$rc" -eq 0 ] && python - "$OUT/BENCH_DEFAULT.json" <<'PY'
+import json, sys, datetime
+line = open(sys.argv[1]).read().strip().splitlines()[-1]
+d = json.loads(line)
+ok = d.get("value") and not d.get("stale")
+if ok:
+    d["captured_round"] = 5
+    d["captured_date"] = datetime.date.today().isoformat()
+    d["hardware"] = "1x TPU v5e (axon tunnel)"
+    open("LAST_GOOD_BENCH.json", "w").write(json.dumps(d) + "\n")
+sys.exit(0 if ok else 1)
+PY
+then log "LAST_GOOD_BENCH.json refreshed from fresh capture"; fi
+
+# 2. flash long-seq crossover (rounds-3/4 kernel showcase), plus a causal
+#    row (the above-diagonal tile skip is measurable fwd+bwd)
+timeout -k 10 2400 python bench.py --attn_all --steps 30 --warmup 5 \
+  > "$OUT/ATTN_ALL.json" 2>"$OUT/attn.err"
+log "attn_all rc=$?"
+timeout -k 10 1200 python bench.py --attn 4096 --causal --steps 30 --warmup 5 \
+  > "$OUT/ATTN_CAUSAL.json" 2>"$OUT/attn_causal.err"
+log "attn_causal rc=$?"
+
+# 3. ResNet-50 at b128 + s2d stem A/B (VERDICT-r4 #3 MFU work)
+for cfg in resnet50_imagenet resnet50_imagenet_s2d; do
+  timeout -k 10 1800 python bench.py --config "$cfg" \
+    > "$OUT/BENCH_$cfg.json" 2>"$OUT/$cfg.err"
+  log "$cfg rc=$? $(head -c 300 "$OUT/BENCH_$cfg.json" 2>/dev/null)"
+done
+
+# 4. ResNet-50 profile capture (MFU anatomy)
+timeout -k 10 1800 python bench.py --config resnet50_imagenet \
+  --profile_dir "$OUT/rn50_profile" > "$OUT/BENCH_rn50_profiled.json" 2>"$OUT/prof.err"
+log "rn50 profile rc=$?"
+
+# 5. ViT-B/16 flash vs xla at 224px, then the 1024px long-context pair
+for cfg in vit_b16_imagenet vit_b16_imagenet_flash vit_b16_1024px_flash vit_b16_1024px_xla; do
+  timeout -k 10 1800 python bench.py --config "$cfg" \
+    > "$OUT/BENCH_$cfg.json" 2>"$OUT/$cfg.err"
+  log "$cfg rc=$? $(head -c 300 "$OUT/BENCH_$cfg.json" 2>/dev/null)"
+done
+
+# 6. sharded-checkpoint path on real device arrays (VERDICT-r4 #6):
+#    scale-1 save (one chip = one shard) then resume — exercises the real
+#    manifest/commit path on TPU-resident arrays, not CPU emulation
+timeout -k 10 1800 python -m tpu_dist.cli.train \
+  --dataset synthetic --model resnet18 --num_classes 16 \
+  --batch_size 256 --epochs 2 --lr 0.1 --synthetic_n 2048 \
+  --ckpt_dir "$OUT/sharded_ckpt" --sharded_ckpt --save_every 1 \
+  > "$OUT/SHARDED_CKPT_SAVE.log" 2>&1
+log "sharded ckpt save rc=$? tail: $(tail -1 "$OUT/SHARDED_CKPT_SAVE.log")"
+timeout -k 10 1800 python -m tpu_dist.cli.train \
+  --dataset synthetic --model resnet18 --num_classes 16 \
+  --batch_size 256 --epochs 3 --lr 0.1 --synthetic_n 2048 \
+  --ckpt_dir "$OUT/sharded_ckpt" --sharded_ckpt --save_every 1 --resume \
+  > "$OUT/SHARDED_CKPT_RESUME.log" 2>&1
+log "sharded ckpt resume rc=$? tail: $(tail -1 "$OUT/SHARDED_CKPT_RESUME.log")"
+
+# 7. remaining --all rows (ga4, fp32, fused) for BENCH_ALL_r05
+timeout -k 10 3600 python bench.py --all > "$OUT/BENCH_ALL.json" 2>"$OUT/all.err"
+log "all rc=$?"
+
+# 8. discriminating convergence on real TPU (TPU_RUN_r05 exhibit):
+#    20 epochs multifactor, scheduled LR, fused device-resident epoch path
+timeout -k 10 2400 python -m tpu_dist.cli.train \
+  --dataset synthetic_multifactor --model resnet18 --num_classes 16 \
+  --batch_size 256 --epochs 20 --lr 0.4 --lr_milestones 10 15 --lr_gamma 0.1 \
+  --synthetic_n 4096 --eval_every 5 --log_every 8 \
+  --log_file "$OUT/TPU_RUN_r05.jsonl" > "$OUT/TPU_RUN_r05.log" 2>&1
+log "convergence run rc=$? tail: $(tail -2 "$OUT/TPU_RUN_r05.log" | tr '\n' ' ')"
+log "session complete"

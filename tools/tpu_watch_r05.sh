@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-5 recovery watcher: probe the wedged axon tunnel with SINGLE bounded
+# attempts (~4 min apart, lock-guarded), and on recovery run the FULL
+# round-5 capture session (tools/tpu_session_r05.sh) — not just one bench —
+# then exit.  Kill leftover watchers from prior rounds before starting
+# (`pgrep -af tpu_watch`).
+cd /root/repo || exit 2
+N=${1:-160}
+OUT=${2:-/root/repo/tpu_r05}
+for i in $(seq 1 "$N"); do
+  ts=$(date -u +%F_%H:%M:%S)
+  timeout -k 10 300 python - <<'EOF'
+from tpu_dist.comm import tpu_lock
+tpu_lock.guard_or_exit("tpu_watch_r05")
+import jax
+d = jax.devices()
+assert d and d[0].platform != "cpu", d
+print("ALIVE", d, flush=True)
+EOF
+  rc=$?
+  echo "$ts attempt $i rc=$rc" >> /tmp/tpu_watch_r05.log
+  if [ "$rc" -eq 0 ]; then
+    echo "$ts tunnel ALIVE - running full r05 capture session" >> /tmp/tpu_watch_r05.log
+    bash tools/tpu_session_r05.sh "$OUT" >> /tmp/tpu_watch_r05.log 2>&1
+    src=$?
+    echo "$(date -u +%F_%H:%M:%S) session rc=$src" >> /tmp/tpu_watch_r05.log
+    # session rc=3 means the tunnel died again before step 0 completed:
+    # keep probing. Any other rc means the session ran; we're done.
+    if [ "$src" -ne 3 ]; then exit 0; fi
+  fi
+  sleep 240
+done
+echo "$(date -u +%F_%H:%M:%S) exhausted $N attempts" >> /tmp/tpu_watch_r05.log
+exit 1

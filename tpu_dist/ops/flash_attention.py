@@ -585,7 +585,11 @@ def _ring_flash_bwd(axis_name, causal, block_q, block_k, interpret, res, do3):
         return blk(kk, vv, True)
 
     def masked(kk, vv):
-        return jnp.zeros_like(kk), jnp.zeros_like(vv), jnp.zeros_like(q3)
+        # must match full/diag's grad_dtype=f32 exactly — lax.switch
+        # requires identical branch output types, and k/v/q may be bf16
+        return (jnp.zeros_like(kk, jnp.float32),
+                jnp.zeros_like(vv, jnp.float32),
+                jnp.zeros_like(q3, jnp.float32))
 
     def rotation(carry, _):
         kk, vv, dka, dva, dq, kv_idx = carry
