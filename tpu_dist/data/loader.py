@@ -78,17 +78,21 @@ class DataLoader:
             -len(self.sampler) // self.batch_size
         )
 
-    def _host_batches(self) -> Iterator[Tuple[np.ndarray, ...]]:
+    def _host_batches(self, start_batch: int = 0) -> Iterator[Tuple[np.ndarray, ...]]:
         idx = self.sampler.indices()
         mask = self.sampler.pad_mask() if self.with_mask else None
-        # epoch- and rank-aware augmentation stream (init_seeds parity,
-        # reference distributed_mp.py:29-39,56)
-        rng = np.random.default_rng(
-            (self.seed, self.sampler.epoch, self.sampler.shard_id)
-        )
         n = len(idx)
         nb = len(self)
-        for b in range(nb):
+        for b in range(start_batch, nb):
+            # Epoch-, rank- AND batch-keyed augmentation stream (init_seeds
+            # parity, reference distributed_mp.py:29-39,56).  Keying by the
+            # batch index makes batch b's augmentation independent of whether
+            # batches 0..b-1 were produced in this process — the property
+            # exact mid-epoch resume relies on (resume at step k replays the
+            # identical remaining stream).
+            rng = np.random.default_rng(
+                (self.seed, self.sampler.epoch, self.sampler.shard_id, b)
+            )
             sel = idx[b * self.batch_size : (b + 1) * self.batch_size]
             pad = self.batch_size - len(sel)
             bmask = mask[b * self.batch_size : b * self.batch_size + len(sel)] if self.with_mask else None
@@ -119,13 +123,21 @@ class DataLoader:
 
     def __iter__(self):
         """Yields device-sharded batches, pipelined one step ahead."""
+        return self.iter_from(0)
+
+    def iter_from(self, start_batch: int):
+        """Iterate from batch ``start_batch`` of the current epoch — the
+        exact-mid-epoch-resume entry point.  Skipped batches are never
+        gathered or augmented (index slicing, not produce-and-discard), and
+        the per-batch RNG keying in ``_host_batches`` guarantees batch b is
+        bit-identical to what an uninterrupted epoch would have produced."""
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         err = []
         stop = threading.Event()
 
         def producer():
             try:
-                for hb in self._host_batches():
+                for hb in self._host_batches(start_batch):
                     batch = mesh_lib.shard_batch(self.mesh, hb, self.shard_axes)
                     # bounded put that notices consumer abandonment (e.g. the
                     # trainer's steps_per_epoch early break) instead of

@@ -79,6 +79,40 @@ def block_forward(blk, t, heads: int, attn_impl: Optional[str] = None):
     return t + _dense(blk["mlp2"], y)
 
 
+def tp_block_forward(
+    blk,
+    t,
+    h_dim: int,
+    copy_to_tp,
+    reduce_from_tp,
+    *,
+    seq_axis: Optional[str] = None,
+    sp_mode: str = "ring",
+    attn_impl: Optional[str] = None,
+):
+    """One Megatron-TP transformer block on [B, S, D]: qkv/mlp1 arrive
+    column-sharded (local heads / local hidden), proj/mlp2 row-sharded;
+    ``copy_to_tp``/``reduce_from_tp`` are the conjugate identity/psum pair
+    from :func:`tpu_dist.parallel.tensor.tp_ops`.  Shared by ViTDef's
+    sequential TP path and the pipeline-parallel stage scan (PP×TP —
+    Megatron's layout: TP inside each pipeline stage)."""
+    y = copy_to_tp(_ln_apply(blk["ln1"], t))
+    qkv = _dense(blk["qkv"], y)  # col-sharded under TP: local heads
+    b, s, qkv_dim = qkv.shape
+    h_loc = qkv_dim // (3 * h_dim)
+    # layout [heads, 3, h_dim]: a contiguous column shard is whole heads
+    qkv = qkv.reshape(b, s, h_loc, 3, h_dim)
+    q, k, v = (qkv[:, :, :, i, :] for i in range(3))
+    o = attn_lib.attention(
+        q, k, v, seq_axis=seq_axis, sp_mode=sp_mode, impl=attn_impl
+    )
+    proj = reduce_from_tp(_dense_local(blk["proj"], o.reshape(b, s, h_loc * h_dim)))
+    t = t + proj + blk["proj"]["b"].astype(t.dtype)
+    y = copy_to_tp(_ln_apply(blk["ln2"], t))
+    y = jax.nn.gelu(_dense(blk["mlp1"], y))  # col-sharded hidden
+    return t + reduce_from_tp(_dense_local(blk["mlp2"], y)) + blk["mlp2"]["b"].astype(t.dtype)
+
+
 def check_pos_capacity(n_tokens: int, pos_table, image_size: int, patch_size: int):
     """Loud error when the input has more patch tokens than the positional
     table (smaller inputs are fine — they use the leading positions)."""
@@ -224,21 +258,10 @@ class ViTDef:
 
         h_dim = self.dim // self.heads
         for blk in params["blocks"]:
-            y = copy_to_tp(_ln_apply(blk["ln1"], t))
-            qkv = _dense(blk["qkv"], y)  # col-sharded under TP: local heads
-            b, s, qkv_dim = qkv.shape
-            h_loc = qkv_dim // (3 * h_dim)
-            # layout [heads, 3, h_dim]: a contiguous column shard is whole heads
-            qkv = qkv.reshape(b, s, h_loc, 3, h_dim)
-            q, k, v = (qkv[:, :, :, i, :] for i in range(3))
-            o = attn_lib.attention(
-                q, k, v, seq_axis=seq_axis, sp_mode=sp_mode, impl=attn_impl
+            t = tp_block_forward(
+                blk, t, h_dim, copy_to_tp, reduce_from_tp,
+                seq_axis=seq_axis, sp_mode=sp_mode, attn_impl=attn_impl,
             )
-            proj = reduce_from_tp(_dense_local(blk["proj"], o.reshape(b, s, h_loc * h_dim)))
-            t = t + proj + blk["proj"]["b"].astype(t.dtype)
-            y = copy_to_tp(_ln_apply(blk["ln2"], t))
-            y = jax.nn.gelu(_dense(blk["mlp1"], y))  # col-sharded hidden
-            t = t + reduce_from_tp(_dense_local(blk["mlp2"], y)) + blk["mlp2"]["b"].astype(t.dtype)
 
         t = _ln_apply(params["ln_f"], t)
         pooled = t.mean(axis=1)

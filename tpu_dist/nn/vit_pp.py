@@ -26,6 +26,7 @@ from tpu_dist.nn.vit import (
     block_forward,
     check_pos_capacity,
     patchify,
+    tp_block_forward,
 )
 from tpu_dist.parallel.pipeline import pipeline_apply, pipeline_apply_interleaved
 
@@ -113,6 +114,53 @@ class ViTPipelineDef:
             "head": {"w": P(), "b": P()},
         }
 
+    def tp_param_specs(self, axis: str):
+        """Pure-TP layout for the stacked-block storage (``--tp`` without
+        ``--pp``): Megatron column/row sharding on the weight dims, the
+        stacked leading (depth) dim unsharded.  The sequential apply path
+        runs the same TP block per stacked row."""
+        from jax.sharding import PartitionSpec as P  # noqa: PLC0415
+
+        blocks = {
+            "ln1": {"scale": P(), "bias": P()},
+            "qkv": {"w": P(None, None, axis), "b": P(None, axis)},
+            "proj": {"w": P(None, axis, None), "b": P()},
+            "ln2": {"scale": P(), "bias": P()},
+            "mlp1": {"w": P(None, None, axis), "b": P(None, axis)},
+            "mlp2": {"w": P(None, axis, None), "b": P()},
+        }
+        return {
+            "patch": {"w": P(), "b": P()},
+            "pos": P(),
+            "blocks": blocks,
+            "ln_f": {"scale": P(), "bias": P()},
+            "head": {"w": P(), "b": P()},
+        }
+
+    def pp_tp_param_specs(self, pp_axis: str, tp_axis: str):
+        """Megatron PP×TP layout: blocks sharded over ``pp_axis`` on the
+        stacked leading (depth) dim AND over ``tp_axis`` on the Megatron
+        dims — qkv/mlp1 column-sharded, proj/mlp2 row-sharded, norms and
+        row-output biases replicated within the stage.  Embed/head stay
+        replicated (small, computed everywhere), same as plain PP."""
+        from jax.sharding import PartitionSpec as P  # noqa: PLC0415
+
+        blocks = {
+            "ln1": {"scale": P(pp_axis), "bias": P(pp_axis)},
+            "qkv": {"w": P(pp_axis, None, tp_axis), "b": P(pp_axis, tp_axis)},
+            "proj": {"w": P(pp_axis, tp_axis, None), "b": P(pp_axis)},
+            "ln2": {"scale": P(pp_axis), "bias": P(pp_axis)},
+            "mlp1": {"w": P(pp_axis, None, tp_axis), "b": P(pp_axis, tp_axis)},
+            "mlp2": {"w": P(pp_axis, tp_axis, None), "b": P(pp_axis)},
+        }
+        return {
+            "patch": {"w": P(), "b": P()},
+            "pos": P(),
+            "blocks": blocks,
+            "ln_f": {"scale": P(), "bias": P()},
+            "head": {"w": P(), "b": P()},
+        }
+
     def _block_leaf_template(self):
         return {
             "ln1": {"scale": 0, "bias": 0},
@@ -133,11 +181,25 @@ class ViTPipelineDef:
         check_pos_capacity(t.shape[1], params["pos"], self.image_size, self.patch_size)
         return t + params["pos"][: t.shape[1]].astype(t.dtype)[None]
 
-    def _stage_scan(self, stage_blocks, t, attn_impl=None):
-        """Run this stage's stacked blocks sequentially."""
+    def _stage_scan(self, stage_blocks, t, attn_impl=None, tp_axis=None):
+        """Run this stage's stacked blocks sequentially.  With ``tp_axis``
+        each block is the Megatron-TP block (qkv/mlp1 arrive column-sharded,
+        proj/mlp2 row-sharded — one psum pair per block over the tp axis)."""
+        if tp_axis is not None:
+            from tpu_dist.parallel.tensor import tp_ops  # noqa: PLC0415
 
-        def body(h, blk):
-            return block_forward(blk, h, self.heads, attn_impl=attn_impl), None
+            copy_to_tp, reduce_from_tp = tp_ops(tp_axis)
+            h_dim = self.dim // self.heads
+
+            def body(h, blk):
+                return tp_block_forward(
+                    blk, h, h_dim, copy_to_tp, reduce_from_tp,
+                    attn_impl=attn_impl,
+                ), None
+        else:
+
+            def body(h, blk):
+                return block_forward(blk, h, self.heads, attn_impl=attn_impl), None
 
         out, _ = lax.scan(body, t, stage_blocks)
         return out
@@ -155,6 +217,7 @@ class ViTPipelineDef:
         train: bool = False,
         axis_name: Optional[str] = None,  # contract parity (no BN)
         pp_axis: Optional[str] = None,
+        tp_axis: Optional[str] = None,
         n_microbatches: int = 0,
         attn_impl: Optional[str] = None,
     ):
@@ -162,6 +225,9 @@ class ViTPipelineDef:
         semantics). With ``pp_axis``: ``params["blocks"]`` arrives holding
         only THIS stage's blocks; the batch is split into ``n_microbatches``
         (default: the stage count) and streamed through the ring.
+        ``tp_axis`` (Megatron PP×TP): each stage's blocks additionally
+        arrive TP-sliced (place params with :meth:`pp_tp_param_specs`);
+        the stage computation runs the TP block with its psum pair.
         """
         del axis_name
         t = self._embed(params, x)
@@ -173,7 +239,7 @@ class ViTPipelineDef:
 
                 inv = np.argsort(perm)
                 blocks = jax.tree_util.tree_map(lambda a: a[inv], blocks)
-            t = self._stage_scan(blocks, t, attn_impl)
+            t = self._stage_scan(blocks, t, attn_impl, tp_axis)
             return self._finish(params, t), state
 
         n_stages = lax.axis_size(pp_axis)
@@ -195,7 +261,7 @@ class ViTPipelineDef:
                 params["blocks"],
             )
             outs = pipeline_apply_interleaved(
-                lambda blocks, h: self._stage_scan(blocks, h, attn_impl),
+                lambda blocks, h: self._stage_scan(blocks, h, attn_impl, tp_axis),
                 chunks,
                 micro,
                 pp_axis,
@@ -204,7 +270,7 @@ class ViTPipelineDef:
             )
         else:
             outs = pipeline_apply(
-                lambda blocks, h: self._stage_scan(blocks, h, attn_impl),
+                lambda blocks, h: self._stage_scan(blocks, h, attn_impl, tp_axis),
                 params["blocks"],
                 micro,
                 pp_axis,

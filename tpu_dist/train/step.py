@@ -191,14 +191,16 @@ def make_train_step(
     if pp_axis is not None:
         if param_specs is None:
             raise ValueError("pp_axis requires param_specs (per-leaf shardings)")
-        if shard_weight_update or seq_axis or tp_axis or ep_axis:
-            # ZeRO-1: flat-layout conflict (stage-sharded leaves). seq/tp/ep
-            # inside a pipeline stage require a 3-D+ mesh with per-stage
-            # sub-meshes — the stage ring (ppermute over pipe) would need
-            # every other collective nested under it.
+        if shard_weight_update or seq_axis or ep_axis:
+            # ZeRO-1: flat-layout conflict (stage-sharded leaves). seq/ep
+            # inside a pipeline stage would thread the token dim through two
+            # conflicting layouts (ring/all_to_all under the stage ring).
+            # tp COMPOSES (Megatron PP×TP): the per-block psum pair runs
+            # over the model axis inside each stage, orthogonal to the pipe
+            # ring's ppermute — tests/test_pp_tp_training.py pins it.
             raise ValueError(
                 "pp_axis is incompatible with shard_weight_update / "
-                "seq_axis / tp_axis / ep_axis (structural; see docstring)"
+                "seq_axis / ep_axis (structural; see docstring)"
             )
     # the expert axis doubles as a data axis outside the MoE: batch shards
     # over both, metrics/loss reduce over both

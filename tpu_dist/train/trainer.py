@@ -140,10 +140,13 @@ class Trainer:
                 "to the stage count (refusing to silently ignore the flag)"
             )
         combined = sum(w > 1 for w in (cfg.sp, cfg.tp, cfg.ep, cfg.pp))
-        if combined > 1 and not (combined == 2 and cfg.sp > 1 and cfg.tp > 1):
+        if combined > 1 and not (
+            combined == 2 and cfg.tp > 1 and (cfg.sp > 1 or cfg.pp > 1)
+        ):
             raise ValueError(
-                "only sp+tp may be combined (3-D DPxTPxSP); other "
-                "sp/tp/ep/pp combinations are not supported yet"
+                "only sp+tp (3-D DPxTPxSP) and pp+tp (Megatron DPxPPxTP) "
+                "may be combined; other sp/tp/ep/pp combinations are not "
+                "supported yet"
             )
         if mesh is not None:
             self.mesh = mesh
@@ -155,6 +158,18 @@ class Trainer:
             self.mesh = mesh_lib.device_mesh(
                 [n // ways, cfg.tp, cfg.sp],
                 [mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS, mesh_lib.SEQ_AXIS],
+            )
+        elif cfg.pp > 1 and cfg.tp > 1:
+            # Megatron layout: tp innermost (adjacent devices — ICI-local
+            # psums every block), pipe next (nearest-neighbor ppermute ring),
+            # data outermost
+            n = len(jax.devices())
+            ways = cfg.pp * cfg.tp
+            if n % ways:
+                raise ValueError(f"{n} devices not divisible by pp*tp={ways}")
+            self.mesh = mesh_lib.device_mesh(
+                [n // ways, cfg.pp, cfg.tp],
+                [mesh_lib.DATA_AXIS, mesh_lib.PIPE_AXIS, mesh_lib.MODEL_AXIS],
             )
         elif cfg.sp > 1 or cfg.tp > 1 or cfg.ep > 1 or cfg.pp > 1:
             ways = max(cfg.sp, cfg.tp, cfg.ep, cfg.pp)
@@ -293,7 +308,8 @@ class Trainer:
                     "tp > 1 is incompatible with fused_epoch / zero1 "
                     "(grad_clip_norm composes — shard-aware norm in step.py)"
                 )
-            self._param_specs = self.model.tp_param_specs(mesh_lib.MODEL_AXIS)
+            if cfg.pp <= 1:  # under PP×TP the pp branch sets combined specs
+                self._param_specs = self.model.tp_param_specs(mesh_lib.MODEL_AXIS)
         if cfg.moe_top_k < 1:
             raise ValueError(f"moe_top_k must be >= 1, got {cfg.moe_top_k}")
         if cfg.moe_top_k > 1:
@@ -389,7 +405,17 @@ class Trainer:
                 f"{m} microbatches, bubble fraction "
                 f"{bubble_fraction(cfg.pp, m, cfg.pp_interleave):.3f}"
             )
-            self._param_specs = self.model.pp_param_specs(mesh_lib.PIPE_AXIS)
+            if cfg.tp > 1:
+                if not hasattr(self.model, "pp_tp_param_specs"):
+                    raise ValueError(
+                        f"model {cfg.model!r} does not support the PP×TP "
+                        f"layout (no pp_tp_param_specs); use vit_pp_* or tp=1"
+                    )
+                self._param_specs = self.model.pp_tp_param_specs(
+                    mesh_lib.PIPE_AXIS, mesh_lib.MODEL_AXIS
+                )
+            else:
+                self._param_specs = self.model.pp_param_specs(mesh_lib.PIPE_AXIS)
 
         # -- data ------------------------------------------------------------
         if cfg.dataset == "synthetic":
@@ -645,12 +671,19 @@ class Trainer:
 
         self._async_ckpt = None  # created lazily by _ckpt_io()
         self.start_epoch = 0
+        self._resume_step = 0  # >0 only after restoring a mid-epoch snapshot
+        # atomic training position for _emergency_save: (state, epoch,
+        # steps_done, epoch_complete). Fresh start = complete through
+        # epoch -1 (nothing to snapshot); _restore_latest re-publishes.
+        self._progress = (self.state, -1, 0, True)
         if cfg.resume and cfg.ckpt_dir:
             # template = current state (matches sharded layouts too);
             # raises on a format-mismatched ckpt_dir (_restore_latest)
             epoch = self._restore_latest()
             if epoch is not None:
-                self.start_epoch = epoch + 1
+                # a mid-epoch snapshot re-enters its own epoch at the saved
+                # step; a clean end-of-epoch ckpt starts the next epoch
+                self.start_epoch = epoch if self._resume_step else epoch + 1
 
     def _ckpt_io(self):
         """Sync module functions, the sharded writer (``--sharded_ckpt``),
@@ -867,8 +900,15 @@ class Trainer:
 
     # -- loops ---------------------------------------------------------------
 
-    def train_epoch(self, epoch: int) -> dict:
+    def train_epoch(self, epoch: int, start_step: int = 0) -> dict:
         if self._fused_runner is not None:
+            if start_step:
+                raise ValueError(
+                    "mid-epoch resume (checkpoint carries mid_epoch_step="
+                    f"{start_step}) is not possible with --fused_epoch: the "
+                    "whole epoch is one compiled call; resume without "
+                    "--fused_epoch to continue from the exact batch"
+                )
             return self._train_epoch_fused(epoch)
         cfg = self.cfg
         self.train_sampler.set_epoch(epoch)  # shuffle correctness (tutorials/2:§2)
@@ -878,10 +918,18 @@ class Trainer:
         t0 = time.time()
         nb = len(self.train_loader)
         metrics = {}
-        for step, (images, labels) in enumerate(self.train_loader):
+        # (state, epoch, completed steps, epoch_complete) published as ONE
+        # attribute so an interrupt can never observe a half-updated pair —
+        # _emergency_save reads ONLY this to decide what to snapshot
+        self._progress = (self.state, epoch, start_step, False)
+        for step, (images, labels) in enumerate(
+            self.train_loader.iter_from(start_step), start=start_step
+        ):
             if cfg.steps_per_epoch is not None and step >= cfg.steps_per_epoch:
                 break
-            self.state, metrics = self.train_step(self.state, images, labels, lr)
+            new_state, metrics = self.train_step(self.state, images, labels, lr)
+            self._progress = (new_state, epoch, step + 1, False)
+            self.state = new_state
             images_seen += cfg.batch_size
             if step % cfg.log_every == 0:
                 m = {k: float(v) for k, v in metrics.items()}  # device sync
@@ -925,6 +973,9 @@ class Trainer:
     def _train_epoch_fused(self, epoch: int) -> dict:
         """One jit call for the whole epoch (tpu_dist/train/epoch.py)."""
         cfg = self.cfg
+        # no per-step grain inside the jit: an interrupt mid-epoch falls
+        # back to the previous clean boundary
+        self._progress = (self.state, epoch, 0, False)
         lr = self._lr(epoch)
         t0 = time.time()
         self.state, metrics = self._fused_runner(
@@ -992,8 +1043,38 @@ class Trainer:
         self.state = self._place_state(restored)
         # pick the recovery backoff up from the checkpoint (see _ckpt_meta)
         self._lr_scale = float(meta.get("lr_scale", 1.0))
+        # exact mid-epoch snapshot (emergency save): re-enter THIS epoch at
+        # this step instead of starting the next epoch
+        self._resume_step = int(meta.get("mid_epoch_step", 0))
+        if self._resume_step:
+            # the step offset pins the data position only under the SAME
+            # per-process batch size and shuffle seed — refuse silent drift
+            # (same contract as the pp/adamw layout stamps above)
+            for key, current in (
+                ("mid_epoch_batch_size", cfg.batch_size),
+                ("mid_epoch_seed", cfg.seed or 0),
+            ):
+                saved = meta.get(key)
+                if saved is not None and saved != current:
+                    raise ValueError(
+                        f"checkpoint {path} is a mid-epoch snapshot taken "
+                        f"with {key.removeprefix('mid_epoch_')}={saved}; "
+                        f"this run uses {current} — the step offset would "
+                        f"re-enter the epoch at the wrong data position "
+                        f"(silently skipping/repeating examples). Resume "
+                        f"with the matching value, or from the last clean "
+                        f"epoch checkpoint."
+                    )
         self._state_poisoned = False
-        rank0_print(f"=> resumed from {path} (epoch {epoch})")
+        if self._resume_step:
+            self._progress = (self.state, epoch, self._resume_step, False)
+            rank0_print(
+                f"=> resumed from {path} (mid-epoch {epoch}, "
+                f"continuing at step {self._resume_step})"
+            )
+        else:
+            self._progress = (self.state, epoch, 0, True)
+            rank0_print(f"=> resumed from {path} (epoch {epoch})")
         return epoch
 
     def _auto_recover(self, err: TrainingDivergedError) -> None:
@@ -1007,7 +1088,7 @@ class Trainer:
         epoch = self._restore_latest()
         if epoch is None:
             raise err
-        self.start_epoch = epoch + 1
+        self.start_epoch = epoch if self._resume_step else epoch + 1
         self._lr_scale *= cfg.recover_lr_factor
         rank0_print(
             f"=> AUTO-RECOVER: {err}; resumed from epoch {epoch}, LR scale "
@@ -1062,19 +1143,27 @@ class Trainer:
     def _emergency_save(self) -> None:
         """Ctrl-C snapshot discipline.
 
+        The ONLY source of truth is ``self._progress = (state, epoch,
+        steps_done, epoch_complete)`` — published atomically at every
+        position change (init/restore, each train step, epoch completion),
+        so there is no interrupt window in which the pieces disagree
+        (including the preamble right after a mid-epoch restore, where a
+        flag-based scheme would misfile k already-trained steps as a clean
+        epoch boundary).
+
         - Cross-process-sharded state (multi-host ZeRO-1/TP) is NOT saved:
           the gather in ckpt save is collective, and Ctrl-C lands at
           unsynchronized points per process — attempting it would deadlock
           the job. Skipped with a message instead.
-        - An interrupt DURING an epoch saves under ``epoch-1`` (the epoch is
-          incomplete; resume re-runs it, no silently skipped data) — unless
-          a clean end-of-epoch ``ckpt_{epoch-1}`` already exists, which is
-          kept (it resumes to the same place without mid-epoch state).
-        - An interrupt BETWEEN epochs (eval/save window after
-          ``train_epoch(N)`` returned) saves the COMPLETE epoch-N state
-          under ``N``.
-        - An interrupt inside epoch 0 saves nothing (a fresh start re-runs
-          epoch 0 anyway).
+        - Position "complete through epoch e": save the clean epoch-e state
+          under ``e`` (kept as-is when ``ckpt_e`` already exists); nothing
+          to save when no epoch has completed (e < 0).
+        - Position "epoch e, k>0 steps done": EXACT snapshot under ``e``
+          stamped ``mid_epoch_step=k`` (+ batch_size/seed, which pin the
+          data position) — ``--resume`` continues epoch e at batch k.
+        - Position "epoch e, 0 steps done" (incl. the fused epoch, which
+          has no step grain): fall back to the previous clean boundary
+          ``e-1`` — kept when already on disk, nothing saved when e == 0.
         """
         cfg = self.cfg
         if not cfg.ckpt_dir:
@@ -1091,11 +1180,12 @@ class Trainer:
         # the LAST file published, and a writer error must not abort the
         # snapshot or mask the interrupt
         self._ckpt_close(suppress=True)
+        state, epoch, steps_done, complete = self._progress
         if jax.process_count() > 1 and (
             cfg.sharded_ckpt  # manifest commit needs a cross-process barrier
             or any(
                 isinstance(l, jax.Array) and not l.is_fully_addressable
-                for l in jax.tree_util.tree_leaves(self.state._asdict())
+                for l in jax.tree_util.tree_leaves(state._asdict())
             )
         ):
             rank0_print(
@@ -1109,46 +1199,98 @@ class Trainer:
         done_marker = (
             "ckpt_{e}.manifest.json" if cfg.sharded_ckpt else "ckpt_{e}.npz"
         )
-        if not self._in_epoch:
-            io.save(cfg.ckpt_dir, self.state, self._last_epoch,
-                    cfg.keep_last_ckpts, extra_meta=self._ckpt_meta())
-            rank0_print(
-                f"=> interrupted after epoch {self._last_epoch} completed; "
-                f"saved as epoch {self._last_epoch}"
-            )
-            return
-        if self._last_epoch <= 0:
-            return
-        prev = self._last_epoch - 1
         import os  # noqa: PLC0415
 
-        if os.path.exists(os.path.join(cfg.ckpt_dir, done_marker.format(e=prev))):
+        def clean_exists(e: int) -> bool:
+            return os.path.exists(
+                os.path.join(cfg.ckpt_dir, done_marker.format(e=e))
+            )
+
+        def save(ckpt_epoch: int, extra_meta: dict, msg: str) -> None:
+            # Donation hazard: when the interrupt lands while a train step
+            # is dispatching, the published state's buffers may be (or
+            # become, racing the aborted dispatch's cleanup) donated to the
+            # in-flight step — serialization then raises "Array has been
+            # deleted".  The save is atomic (tmp + rename), so the failed
+            # attempt leaves nothing partial; skip gracefully rather than
+            # crash the interrupt handler.
+            try:
+                io.save(cfg.ckpt_dir, state, ckpt_epoch, cfg.keep_last_ckpts,
+                        extra_meta=extra_meta)
+            except RuntimeError as e:
+                if "deleted" not in str(e):
+                    raise
+                rank0_print(
+                    "=> interrupted while a step held the donated state "
+                    "buffers — emergency snapshot skipped; resume from the "
+                    "last periodic checkpoint"
+                )
+                return
+            rank0_print(msg)
+
+        if complete:
+            if epoch < 0:
+                return  # nothing trained yet
+            if clean_exists(epoch):
+                rank0_print(
+                    f"=> interrupted after epoch {epoch} completed; clean "
+                    f"ckpt_{epoch} already on disk — kept as-is"
+                )
+                return
+            save(epoch, self._ckpt_meta(),
+                 f"=> interrupted after epoch {epoch} completed; "
+                 f"saved as epoch {epoch}")
+            return
+        if steps_done > 0:
+            # Exact mid-epoch snapshot: state after steps_done steps of
+            # epoch, stamped with the step offset plus the two config
+            # values the data position depends on (the epoch-seeded
+            # permutation makes (seed, epoch, batch_size, step) pin it
+            # exactly); _restore_latest refuses a mismatched resume.
+            save(epoch,
+                 {**self._ckpt_meta(),
+                  "mid_epoch_step": int(steps_done),
+                  "mid_epoch_batch_size": cfg.batch_size,
+                  "mid_epoch_seed": cfg.seed or 0},
+                 f"=> interrupted mid-epoch {epoch} after step "
+                 f"{steps_done - 1}; exact snapshot saved — resume continues "
+                 f"epoch {epoch} at step {steps_done}")
+            return
+        if epoch <= 0:
+            return
+        prev = epoch - 1
+        if clean_exists(prev):
             rank0_print(
-                f"=> interrupted mid-epoch {self._last_epoch}; clean ckpt_{prev} "
-                f"already on disk — kept as-is, resume re-runs epoch {self._last_epoch}"
+                f"=> interrupted mid-epoch {epoch}; clean ckpt_{prev} "
+                f"already on disk — kept as-is, resume re-runs epoch {epoch}"
             )
             return
-        io.save(cfg.ckpt_dir, self.state, prev, cfg.keep_last_ckpts,
-                extra_meta=self._ckpt_meta())
-        rank0_print(
-            f"=> interrupted mid-epoch {self._last_epoch}; state saved to "
-            f"{cfg.ckpt_dir} as epoch {prev} — resume re-runs epoch "
-            f"{self._last_epoch}"
-        )
+        save(prev, self._ckpt_meta(),
+             f"=> interrupted mid-epoch {epoch}; state saved to "
+             f"{cfg.ckpt_dir} as epoch {prev} — resume re-runs epoch "
+             f"{epoch}")
 
     def _fit_loop(self, epochs: int, history, last: dict) -> dict:
         cfg = self.cfg
         for epoch in range(self.start_epoch, epochs):
             self._last_epoch = epoch
-            self._in_epoch = True  # _emergency_save: mid-epoch vs between
+            self._in_epoch = True
+            # a restored mid-epoch snapshot applies to its own epoch only.
+            # _progress stays whatever was last published (the restore point
+            # or the previous epoch's completion) until train_epoch's own
+            # publish — every interrupt window reads a consistent position.
+            start_step, self._resume_step = self._resume_step, 0
             if cfg.profile_dir and epoch == self.start_epoch:
                 from tpu_dist.metrics.profiler import trace  # noqa: PLC0415
 
                 with trace(cfg.profile_dir):
-                    last = self.train_epoch(epoch)
+                    last = self.train_epoch(epoch, start_step=start_step)
             else:
-                last = self.train_epoch(epoch)
+                last = self.train_epoch(epoch, start_step=start_step)
             self._in_epoch = False
+            # epoch fully trained: one atomic publish flips the position to
+            # "complete through epoch" for the eval/save window below
+            self._progress = (self.state, epoch, 0, True)
             history.log("train_epoch", epoch=epoch, **last)
             if self._tb is not None:
                 for k in ("loss", "acc1", "acc5", "images_per_sec"):
