@@ -1,0 +1,97 @@
+"""Worker for the multi-host PP×TP (Megatron layout) test.
+
+Launched by tests/test_multihost.py as 2 processes × 4 CPU devices: one
+8-device global mesh laid out ``[data=2, pipe=2, model=2]`` HOST-MAJOR,
+so every pipe×model group of 4 is intra-host (the stage ring's ppermute
+and each block's TP psums stay on the ICI side of the ICI/DCN split,
+only the data axis crosses processes).  The same ``run_pp_tp_training``
+is also called by the parent test in-process (1 process × 8 devices) as
+the reference.
+
+Usage: python tests/_mp_worker_pp_tp.py <coordinator> <num_procs> <proc_id>
+"""
+
+import os
+import sys
+
+if __name__ == "__main__":
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+import jax  # noqa: E402
+
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _to_host(x) -> np.ndarray:
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(x))
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def run_pp_tp_training():
+    """Train a tiny staged+TP ViT 3 steps on a [data=2, pipe=2, model=2]
+    mesh from ALL global devices; returns (loss, replicated fingerprint,
+    pipe×model-sharded block fingerprint)."""
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.nn.vit_pp import ViTPipelineDef
+    from tpu_dist.train.optim import SGD
+    from tpu_dist.train.state import TrainState
+    from tpu_dist.train.step import make_train_step
+
+    mesh = mesh_lib.device_mesh([2, 2, 2], ["data", "pipe", "model"])
+    assert mesh_lib.model_axes_intra_host(mesh, ["pipe", "model"]), (
+        "host-major mesh must keep the pipe ring and tp groups intra-host"
+    )
+
+    model = ViTPipelineDef(image_size=16, patch_size=4, dim=32, depth=4,
+                           heads=4, num_classes=5)
+    specs = model.pp_tp_param_specs("pipe", "model")
+    opt = SGD()
+    params, s = model.init(jax.random.PRNGKey(0))
+    st = TrainState.create(params, s, opt)
+    state = TrainState(
+        params=mesh_lib.place_host_tree(mesh, st.params, specs),
+        bn_state=mesh_lib.place_host_tree(mesh, st.bn_state),
+        opt_state=mesh_lib.place_host_tree(mesh, st.opt_state, specs),
+        step=mesh_lib.place_host_tree(mesh, st.step),
+    )
+    step = make_train_step(
+        model.apply, opt, mesh, sync_bn=False, donate=False,
+        pp_axis="pipe", tp_axis="model", param_specs=specs,
+    )
+
+    rng = np.random.default_rng(0)
+    all_x = rng.normal(size=(8, 16, 16, 3)).astype(np.float32)
+    all_y = rng.integers(0, 5, 8).astype(np.int32)
+    per = all_x.shape[0] // jax.process_count()
+    lo = jax.process_index() * per
+    xs = mesh_lib.shard_batch(mesh, all_x[lo:lo + per])
+    ys = mesh_lib.shard_batch(mesh, all_y[lo:lo + per])
+
+    for _ in range(3):
+        state, metrics = step(state, xs, ys, 0.05)
+    loss = float(_to_host(metrics["loss"]))
+    fp_rep = float(_to_host(state.params["patch"]["b"]).sum())
+    fp_blk = float(_to_host(state.params["blocks"]["qkv"]["w"]).sum())
+    return loss, fp_rep, fp_blk
+
+
+def main(coordinator: str, num_procs: int, proc_id: int) -> None:
+    from tpu_dist.comm import mesh as mesh_lib
+
+    mesh_lib.initialize_distributed(coordinator, num_procs, proc_id)
+    assert jax.process_count() == num_procs
+    assert jax.local_device_count() == 4
+    loss, fp_rep, fp_blk = run_pp_tp_training()
+    print(f"PPTPRESULT {proc_id} {loss:.6f} {fp_rep:.6f} {fp_blk:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))

@@ -113,6 +113,24 @@ def test_two_process_expert_parallel_matches_single_process():
     assert abs(fp_ep - ref_ep) < 1e-3, (fp_ep, ref_ep)
 
 
+def test_two_process_pp_tp_matches_single_process():
+    """2 hosts × 4 devices, pp=2 × tp=2 on a host-major
+    [data=2, pipe=2, model=2] mesh (the Megatron layout): the stage ring's
+    ppermute AND each block's TP psums stay intra-host while the data axis
+    crosses processes. Workers agree with each other AND with the same run
+    on a single-process 8-device mesh."""
+    results, _ = _launch_workers("_mp_worker_pp_tp.py", "PPTPRESULT")
+    assert results["0"] == results["1"], results
+
+    from tests._mp_worker_pp_tp import run_pp_tp_training
+
+    ref_loss, ref_rep, ref_blk = run_pp_tp_training()
+    loss, fp_rep, fp_blk = (float(v) for v in results["0"])
+    assert abs(loss - ref_loss) < 1e-4, (loss, ref_loss)
+    assert abs(fp_rep - ref_rep) < 1e-4, (fp_rep, ref_rep)
+    assert abs(fp_blk - ref_blk) < 1e-3, (fp_blk, ref_blk)
+
+
 def test_two_process_ring_flash_sp_matches_single_process():
     """2 hosts × 4 devices, sp=4 RING-FLASH on a host-major [data=2, seq=4]
     mesh: the ring's ppermute neighborhood stays intra-host while the data

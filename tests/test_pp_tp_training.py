@@ -139,6 +139,49 @@ def test_trainer_pp_tp_e2e_with_eval(tmp_path):
     assert np.isfinite(t2.fit()["loss"])
 
 
+def test_interleaved_pp_tp_training_matches_single_device():
+    """Interleave composes too: virtual stages (device-major chunk storage)
+    × TP inside each chunk, on the same [data, pipe, model] mesh."""
+    from jax.sharding import NamedSharding
+
+    model = ViTPipelineDef(image_size=16, patch_size=4, dim=32, depth=8,
+                           heads=4, num_classes=5, interleave=2, pp_stages=2)
+    opt = SGD()
+    mesh3d = mesh_lib.device_mesh([2, 2, 2], ["data", "pipe", "model"])
+    mesh1 = mesh_lib.device_mesh([1], ["data"], jax.devices()[:1])
+    specs = model.pp_tp_param_specs("pipe", "model")
+    params, s = model.init(jax.random.PRNGKey(3))
+    st = TrainState.create(params, s, opt)
+    place = lambda tree: jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh3d, spec)),
+        tree, specs,
+    )
+    s_pt = TrainState(place(st.params),
+                      jax.device_put(st.bn_state, mesh_lib.replicated(mesh3d)),
+                      place(st.opt_state),
+                      jax.device_put(st.step, mesh_lib.replicated(mesh3d)))
+    s_1 = jax.device_put(st, mesh_lib.replicated(mesh1))
+    step_pt = make_train_step(model.apply, opt, mesh3d, sync_bn=False,
+                              donate=False, pp_axis="pipe", tp_axis="model",
+                              param_specs=specs,
+                              model_kwargs={"n_microbatches": 2})
+    step_1 = make_train_step(model.apply, opt, mesh1, sync_bn=False, donate=False)
+    rng = np.random.default_rng(4)
+    for _ in range(2):
+        x = rng.normal(size=(8, 16, 16, 3)).astype(np.float32)
+        y = rng.integers(0, 5, 8).astype(np.int32)
+        s_pt, m_pt = step_pt(s_pt, mesh_lib.shard_batch(mesh3d, x),
+                             mesh_lib.shard_batch(mesh3d, y), 0.05)
+        s_1, m_1 = step_1(s_1, mesh_lib.shard_batch(mesh1, x),
+                          mesh_lib.shard_batch(mesh1, y), 0.05)
+    np.testing.assert_allclose(float(m_pt["loss"]), float(m_1["loss"]), rtol=1e-4)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(s_pt.params)),
+        jax.tree_util.tree_leaves(jax.device_get(s_1.params)),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5)
+
+
 def test_trainer_tp_only_on_pipeline_model():
     """--tp without --pp on a vit_pp_* model: the stacked-block storage
     trains under pure Megatron TP (reviewer finding r5: the tp capability
