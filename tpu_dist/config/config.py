@@ -173,9 +173,9 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--fused_epoch", action="store_true",
                    help="device-resident data: one jit call per epoch")
     p.add_argument("--shard_weight_update", "--zero1", action="store_true",
-                   help="ZeRO-1 weight-update sharding (arXiv:2004.13336); "
-                        "plain-DP SGD fast path by design — use --fsdp for "
-                        "anything beyond that")
+                   help="ZeRO-1 weight-update sharding (arXiv:2004.13336), "
+                        "sgd or adamw; plain-DP fast path by design — use "
+                        "--fsdp for model-parallel compositions")
     p.add_argument("--fsdp", action="store_true",
                    help="fully-sharded data parallelism (ZeRO-3): params and "
                         "momentum sharded over the data axis via GSPMD")

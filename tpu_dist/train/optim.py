@@ -17,6 +17,7 @@ the momentum state is a plain pytree the checkpoint layer can serialize.
 
 from __future__ import annotations
 
+import math
 from typing import Sequence, Tuple
 
 import jax
@@ -164,8 +165,45 @@ class AdamW:
 
         return {"mu": param_specs, "nu": param_specs, "count": P()}
 
-    def update(self, grads, opt_state, params, lr):
-        """Returns ``(new_params, new_opt_state)``; ``lr`` may be traced."""
+    # -- ZeRO-1 flat layout (shard_weight_update) ----------------------------
+
+    def flat_state_specs(self, axis: str):
+        """Partition specs for the ZeRO-1 flat layout: mu/nu are 1/n-sharded
+        flat vectors, the step count replicates."""
+        from jax.sharding import PartitionSpec as P  # noqa: PLC0415
+
+        return {"mu": P(axis), "nu": P(axis), "count": P()}
+
+    def init_flat_state(self, length: int):
+        """Fresh ZeRO-1 state: flat f32 mu/nu of the padded raveled-param
+        length (sharding applied by the caller)."""
+        return {
+            "mu": jnp.zeros((length,), jnp.float32),
+            "nu": jnp.zeros((length,), jnp.float32),
+            "count": jnp.zeros((), jnp.int32),
+        }
+
+    def leaf_wd_intervals(self, params):
+        """The ``auto`` decay mask in flat coordinates: [start, end) ranges
+        of the raveled param vector that receive weight decay, derived from
+        ``_wd_tree`` (single source of truth for the mask rule) and the
+        ravel order (= ``tree_leaves`` order, which ``ravel_pytree``
+        concatenates). The ZeRO-1 update rebuilds its shard's per-element
+        decay from these static intervals with iota comparisons — no
+        model-length constant vector ever materializes."""
+        wd_leaves = jax.tree_util.tree_leaves(self._wd_tree(params))
+        out, off = [], 0
+        for p, w in zip(jax.tree_util.tree_leaves(params), wd_leaves):
+            n = int(math.prod(p.shape))
+            if w:
+                out.append((off, off + n, float(w)))
+            off += n
+        return out
+
+    def update(self, grads, opt_state, params, lr, wd_tree=None):
+        """Returns ``(new_params, new_opt_state)``; ``lr`` may be traced.
+        ``wd_tree`` overrides the per-leaf decay (the ZeRO-1 flat path
+        passes a positional per-element vector)."""
         b1, b2, eps = self.b1, self.b2, self.eps
         tm = jax.tree_util.tree_map
         count = opt_state["count"] + 1
@@ -175,9 +213,11 @@ class AdamW:
 
         mu = tm(lambda m, g: b1 * m + (1.0 - b1) * g, opt_state["mu"], grads)
         nu = tm(lambda v, g: b2 * v + (1.0 - b2) * jnp.square(g), opt_state["nu"], grads)
+        if wd_tree is None:
+            wd_tree = self._wd_tree(params)
         new_params = tm(
             lambda p, m, v, wd: p - lr * ((m / bc1) / (jnp.sqrt(v / bc2) + eps) + wd * p),
-            params, mu, nu, self._wd_tree(params),
+            params, mu, nu, wd_tree,
         )
         return new_params, {"mu": mu, "nu": nu, "count": count}
 

@@ -159,17 +159,17 @@ def make_train_step(
         if param_specs is None:
             raise ValueError("tp_axis requires param_specs (per-leaf shardings)")
         if shard_weight_update:
-            # ZeRO-1 is BY DESIGN the data-parallel SGD fast path: it ravels
-            # the (replicated) param tree into one flat vector and
-            # reduce-scatters over the data axis. Under TP the local tree is
-            # a per-shard slice, so the flat layout no longer lines up —
-            # and rather than grow a second sharding engine, that territory
-            # belongs to FSDP (parallel/fsdp.py), which shards per-leaf via
-            # GSPMD and composes by specs. Final scoping decision, not
-            # deferred work (VERDICT r2 #6).
+            # ZeRO-1 is BY DESIGN the data-parallel fast path (SGD or
+            # AdamW): it ravels the (replicated) param tree into flat
+            # vectors and reduce-scatters over the data axis. Under TP the
+            # local tree is a per-shard slice, so the flat layout no longer
+            # lines up — and rather than grow a second sharding engine,
+            # that territory belongs to FSDP (parallel/fsdp.py), which
+            # shards per-leaf via GSPMD and composes by specs. Final
+            # scoping decision, not deferred work (VERDICT r2 #6).
             raise ValueError(
                 "tp_axis + shard_weight_update is out of ZeRO-1's scope "
-                "(DP-only SGD fast path by design) — use --fsdp for "
+                "(DP-only fast path by design) — use --fsdp for "
                 "sharded weight updates beyond plain DP"
             )
         # tp_axis + seq_axis composes (3-D DPxTPxSP): the conjugate VJP ops
@@ -375,7 +375,11 @@ def make_train_step(
 
     def _sharded_update(state: TrainState, grads, lr):
         """reduce-scatter grads → update own param shard with sharded
-        momentum → all-gather params (arXiv:2004.13336)."""
+        optimizer state → all-gather params (arXiv:2004.13336). Works for
+        any optimizer whose update is elementwise over its buffers: SGD's
+        momentum rides as one flat vector, AdamW's mu/nu as two (with the
+        ``auto`` decay mask converted to a positional per-element vector —
+        leaf ranks are invisible in the flat layout)."""
         from jax.flatten_util import ravel_pytree  # noqa: PLC0415
 
         if seq_axis is not None:
@@ -399,15 +403,33 @@ def make_train_step(
             g_shard = g_shard * scale
         idx = lax.axis_index(axis)
         p_shard = lax.dynamic_slice_in_dim(jnp.pad(flat_p, (0, pad)), idx * chunk, chunk)
+        kw = {}
+        if hasattr(optimizer, "leaf_wd_intervals"):
+            # AdamW: the rank-based decay mask in flat coordinates — this
+            # shard's per-element decay built from static leaf intervals
+            # (iota comparisons; never a model-length constant in HBM)
+            pos = idx * chunk + jnp.arange(chunk)
+            wd_shard = jnp.zeros((chunk,), jnp.float32)
+            for start, end, w in optimizer.leaf_wd_intervals(state.params):
+                wd_shard = wd_shard + w * (
+                    (pos >= start) & (pos < end)
+                ).astype(jnp.float32)
+            kw["wd_tree"] = wd_shard
         new_p_shard, new_b_shard = optimizer.update(
-            g_shard, state.opt_state, p_shard, lr
+            g_shard, state.opt_state, p_shard, lr, **kw
         )
         flat_new = lax.all_gather(new_p_shard, axis, tiled=True)[:L]
         return unravel(flat_new), new_b_shard
 
     p_spec = param_specs if param_specs is not None else P()
     if shard_weight_update:
-        opt_spec = P(axis)  # ZeRO-1 flat momentum vector (SGD only)
+        # ZeRO-1 flat layout: one sharded vector per optimizer buffer
+        # (SGD momentum, or AdamW mu/nu + replicated count)
+        opt_spec = (
+            optimizer.flat_state_specs(axis)
+            if hasattr(optimizer, "flat_state_specs")
+            else P(axis)
+        )
     elif hasattr(optimizer, "state_specs"):
         # optimizer state may not mirror the param tree (AdamW's
         # {mu, nu, count}) — ask the optimizer for its layout
@@ -431,16 +453,27 @@ def make_train_step(
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
-def init_sharded_opt_state(params, mesh: Mesh, axis: str = mesh_lib.DATA_AXIS):
-    """Flat, axis-sharded momentum buffer for ``shard_weight_update`` steps:
-    one f32 vector of ceil(L/n)*n zeros laid over the axis (each replica
-    holds its 1/n shard)."""
+def init_sharded_opt_state(
+    params, mesh: Mesh, axis: str = mesh_lib.DATA_AXIS, optimizer=None,
+):
+    """Flat, axis-sharded optimizer state for ``shard_weight_update`` steps:
+    f32 vectors of ceil(L/n)*n zeros laid over the axis (each replica holds
+    its 1/n shard). Default (``optimizer=None``): SGD's single momentum
+    vector. An optimizer exposing ``init_flat_state``/``flat_state_specs``
+    (AdamW) gets its own flat layout — mu/nu sharded, count replicated."""
     from jax.flatten_util import ravel_pytree  # noqa: PLC0415
     from jax.sharding import NamedSharding  # noqa: PLC0415
 
     L = ravel_pytree(params)[0].shape[0]
     n = int(mesh.shape[axis])
     chunk = -(-L // n)
+    if optimizer is not None and hasattr(optimizer, "init_flat_state"):
+        state = optimizer.init_flat_state(chunk * n)
+        specs = optimizer.flat_state_specs(axis)
+        return jax.tree_util.tree_map(
+            lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+            state, specs,
+        )
     return jax.device_put(
         jnp.zeros((chunk * n,), jnp.float32), NamedSharding(mesh, P(axis))
     )

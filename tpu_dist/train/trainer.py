@@ -530,12 +530,6 @@ class Trainer:
                     "fused_optimizer is the Pallas fused-SGD kernel; adamw "
                     "uses the plain (XLA-fused) update"
                 )
-            if cfg.shard_weight_update:
-                raise ValueError(
-                    "zero1 weight-update sharding supports sgd only (its "
-                    "flat layout assumes one momentum buffer); use --fsdp "
-                    "to shard adamw state"
-                )
             from tpu_dist.train.optim import AdamW  # noqa: PLC0415
 
             self.optimizer = AdamW(
@@ -888,13 +882,29 @@ class Trainer:
         if cfg.shard_weight_update:
             from tpu_dist.train.step import init_sharded_opt_state  # noqa: PLC0415
 
-            tmpl = init_sharded_opt_state(state.params, self.mesh)
+            tmpl = init_sharded_opt_state(
+                state.params, self.mesh, optimizer=self.optimizer
+            )
             opt_np = state.opt_state
-            # fresh init (tree layout) vs restored flat vector
-            if hasattr(opt_np, "shape") and getattr(opt_np, "ndim", None) == 1:
-                opt = jax.device_put(np.asarray(opt_np), tmpl.sharding)
+            # fresh init (per-leaf tree layout) vs a restored flat state:
+            # restored matches the template's structure AND leaf shapes
+            # (SGD: one 1-D vector; AdamW: {mu, nu} vectors + count scalar)
+            t_leaves, t_def = jax.tree_util.tree_flatten(tmpl)
+            o_leaves, o_def = jax.tree_util.tree_flatten(opt_np)
+            if t_def == o_def and all(
+                getattr(o, "shape", None) == t.shape
+                for o, t in zip(o_leaves, t_leaves)
+            ):
+                # restored flat state: re-place each buffer with the
+                # template's shard layout (a wrong-width checkpoint never
+                # reaches here — the ckpt layer's shape validation raises
+                # first)
+                opt = jax.tree_util.tree_map(
+                    lambda o, t: jax.device_put(np.asarray(o), t.sharding),
+                    opt_np, tmpl,
+                )
             else:
-                opt = tmpl  # fresh zeros
+                opt = tmpl  # fresh init (per-leaf tree layout) → flat zeros
             placed = placed._replace(opt_state=opt)
         return placed
 
