@@ -101,8 +101,6 @@ def cosine_lr(base_lr: float, total_epochs: int, warmup_epochs: int = 0, min_lr:
     transformer/ViT schedule (no reference counterpart; the reference only
     ships MultiStepLR, ``distributed.py:64``). Epoch-granular like the
     reference's scheduler."""
-    import math
-
     def schedule(epoch: int) -> float:
         if warmup_epochs > 0 and epoch < warmup_epochs:
             return float(base_lr * (epoch + 1) / warmup_epochs)

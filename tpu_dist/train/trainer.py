@@ -878,14 +878,18 @@ class Trainer:
                 ),
                 step=mesh_lib.place_host_tree(self.mesh, state.step),
             )
-        placed = jax.device_put(state, rep)
         if cfg.shard_weight_update:
+            # replace the per-leaf init tree BEFORE replication — device_put
+            # of the full mu/nu (2× params in f32) to every chip just to
+            # discard it for the flat template would spike init HBM on
+            # exactly the models ZeRO-1 exists for
+            opt_np = state.opt_state
+            placed = jax.device_put(state._replace(opt_state=()), rep)
             from tpu_dist.train.step import init_sharded_opt_state  # noqa: PLC0415
 
             tmpl = init_sharded_opt_state(
                 state.params, self.mesh, optimizer=self.optimizer
             )
-            opt_np = state.opt_state
             # fresh init (per-leaf tree layout) vs a restored flat state:
             # restored matches the template's structure AND leaf shapes
             # (SGD: one 1-D vector; AdamW: {mu, nu} vectors + count scalar)
@@ -905,8 +909,8 @@ class Trainer:
                 )
             else:
                 opt = tmpl  # fresh init (per-leaf tree layout) → flat zeros
-            placed = placed._replace(opt_state=opt)
-        return placed
+            return placed._replace(opt_state=opt)
+        return jax.device_put(state, rep)
 
     # -- loops ---------------------------------------------------------------
 
