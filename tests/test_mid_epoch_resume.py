@@ -160,6 +160,44 @@ def test_reinterrupt_before_first_resumed_step_keeps_exact_position(
     assert read_meta(path).get("mid_epoch_step") == 3
 
 
+def test_mid_epoch_resume_sharded_ckpt(tmp_path, monkeypatch):
+    """The exact-resume meta rides the sharded-checkpoint format too: the
+    emergency snapshot goes through ShardedCheckpointer with the same
+    mid_epoch_step stamp, and --resume re-enters at the exact batch."""
+    from tpu_dist.ckpt import latest_sharded_checkpoint, read_sharded_meta
+
+    t_full = Trainer(_cfg())
+    t_full.fit()
+    want = t_full.state
+
+    cfg = _cfg(ckpt_dir=str(tmp_path), sharded_ckpt=True)
+    t = Trainer(cfg)
+    calls = {"n": 0}
+    orig_step = t.train_step
+
+    def interrupting(state, images, labels, lr):
+        calls["n"] += 1
+        if calls["n"] == 14:
+            raise KeyboardInterrupt
+        return orig_step(state, images, labels, lr)
+
+    monkeypatch.setattr(t, "train_step", interrupting)
+    with pytest.raises(KeyboardInterrupt):
+        t.fit()
+
+    found = latest_sharded_checkpoint(str(tmp_path))
+    assert found is not None
+    path, epoch = found
+    assert epoch == 1
+    assert read_sharded_meta(path).get("mid_epoch_step") == 3
+
+    t2 = Trainer(cfg.replace(resume=True))
+    assert t2.start_epoch == 1 and t2._resume_step == 3
+    t2.fit()
+    _params_equal(t2.state.params, want.params)
+    _params_equal(t2.state.opt_state, want.opt_state)
+
+
 def test_mid_epoch_resume_refuses_batch_size_drift(tmp_path, monkeypatch):
     """The step offset only pins the data position under the same batch
     size/seed — a mismatched resume must refuse, not silently skip data."""
