@@ -198,6 +198,41 @@ def test_mid_epoch_resume_sharded_ckpt(tmp_path, monkeypatch):
     _params_equal(t2.state.opt_state, want.opt_state)
 
 
+def test_periodic_mid_epoch_snapshots_survive_kill(tmp_path):
+    """--mid_epoch_save_every: periodic exact snapshots DURING the epoch,
+    so a hard kill (no interrupt handler, no emergency save) loses at most
+    N steps — resume re-enters at the last snapshot's batch and finishes
+    bit-identical to an uninterrupted run."""
+    from tpu_dist.ckpt import latest_checkpoint, read_meta
+
+    t_full = Trainer(_cfg(epochs=1))
+    t_full.fit()
+    want = t_full.state
+
+    cfg = _cfg(epochs=1, ckpt_dir=str(tmp_path), mid_epoch_save_every=4)
+    t = Trainer(cfg)
+    # simulate kill -9 after the epoch's work: run the raw epoch (which
+    # writes snapshots at steps 4 and 8 of 10) and abandon the trainer
+    # without fit()'s clean end-of-epoch save or any emergency path
+    t.train_epoch(0)
+    path, epoch = latest_checkpoint(str(tmp_path))
+    assert epoch == 0
+    assert read_meta(path).get("mid_epoch_step") == 8
+
+    t2 = Trainer(cfg.replace(resume=True))
+    assert t2.start_epoch == 0 and t2._resume_step == 8
+    t2.fit()
+    assert int(t2.state.step) == int(want.step)
+    _params_equal(t2.state.params, want.params)
+    _params_equal(t2.state.opt_state, want.opt_state)
+
+
+def test_mid_epoch_save_every_rejected_with_fused_epoch():
+    with pytest.raises(ValueError, match="no step boundary"):
+        Trainer(_cfg(fused_epoch=True, mid_epoch_save_every=2,
+                     batch_size=256, synthetic_n=512))
+
+
 def test_mid_epoch_resume_refuses_batch_size_drift(tmp_path, monkeypatch):
     """The step offset only pins the data position under the same batch
     size/seed — a mismatched resume must refuse, not silently skip data."""

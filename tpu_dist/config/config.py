@@ -80,6 +80,9 @@ class TrainConfig:
     ckpt_dir: Optional[str] = None
     save_every: int = 15           # dead utils/config.py:7 'save_epoch', made real
     keep_last_ckpts: Optional[int] = None  # prune to N newest (None = keep all)
+    mid_epoch_save_every: int = 0  # >0: periodic EXACT snapshots every N steps
+                                   # inside an epoch (kill-9 safety for long
+                                   # epochs; resume re-enters at the batch)
     resume: bool = False
     async_ckpt: bool = False       # overlap ckpt npz writes with training
                                    # (ckpt/checkpoint.py::AsyncCheckpointer)
@@ -261,6 +264,11 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--eval_every", type=int, default=d.eval_every,
                    help="epochs between evaluations; 0 disables")
     p.add_argument("--save_every", type=int, default=d.save_every)
+    p.add_argument("--mid_epoch_save_every", type=int,
+                   default=d.mid_epoch_save_every,
+                   help="periodic exact mid-epoch snapshots every N steps "
+                        "(0 = off); resume continues at the exact batch — "
+                        "kill-9 safety for long epochs")
     p.add_argument("--steps_per_epoch", type=int, default=None,
                    help="cap steps per epoch (smokes/benches)")
     p.add_argument("--log_every", type=int, default=d.log_every)

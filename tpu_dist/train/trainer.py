@@ -576,6 +576,12 @@ class Trainer:
                 "step by design — the fused-epoch scan keeps params "
                 "replicated; use --fsdp for sharded state"
             )
+        if cfg.mid_epoch_save_every and cfg.fused_epoch:
+            raise ValueError(
+                "mid_epoch_save_every needs per-step granularity; "
+                "--fused_epoch compiles the whole epoch into one call "
+                "(no step boundary to snapshot at)"
+            )
         # place on the mesh (DDP's init-time param broadcast; sharded
         # placements for TP params / ZeRO-1 optimizer state)
         self.state = self._place_state(state)
@@ -945,6 +951,32 @@ class Trainer:
             self._progress = (new_state, epoch, step + 1, False)
             self.state = new_state
             images_seen += cfg.batch_size
+            if (
+                cfg.mid_epoch_save_every
+                and cfg.ckpt_dir
+                and (step + 1) % cfg.mid_epoch_save_every == 0
+            ):
+                # periodic EXACT snapshot (kill-9 safety for long epochs):
+                # same stamp as the interrupt path — ckpt_{epoch} carries
+                # the step offset until the clean end-of-epoch save
+                # overwrites it. Rides the async writer when configured.
+                # NaN guard FIRST: every other save path refuses to publish
+                # a poisoned state, and this one must too (the log_every
+                # guard below may not have run since divergence).
+                if cfg.nan_guard and not np.isfinite(float(metrics["loss"])):
+                    raise TrainingDivergedError(
+                        f"non-finite loss {float(metrics['loss'])} at epoch "
+                        f"{epoch} step {step} (lr={lr}) — caught at the "
+                        f"mid-epoch snapshot boundary before writing it; "
+                        f"restore from ckpt_dir to recover"
+                    )
+                self._ckpt_io().save(
+                    cfg.ckpt_dir, new_state, epoch, cfg.keep_last_ckpts,
+                    extra_meta={**self._ckpt_meta(),
+                                "mid_epoch_step": step + 1,
+                                "mid_epoch_batch_size": cfg.batch_size,
+                                "mid_epoch_seed": cfg.seed or 0},
+                )
             if step % cfg.log_every == 0:
                 m = {k: float(v) for k, v in metrics.items()}  # device sync
                 if cfg.nan_guard and not np.isfinite(m["loss"]):
@@ -1338,7 +1370,14 @@ class Trainer:
                         cfg.ckpt_dir, self.state, epoch, t1,
                         extra_meta=self._ckpt_meta(),
                     )
-            if cfg.ckpt_dir and (epoch + 1) % cfg.save_every == 0:
+            if cfg.ckpt_dir and (
+                (epoch + 1) % cfg.save_every == 0
+                # with periodic mid-epoch snapshots on, EVERY epoch end
+                # writes the clean checkpoint — otherwise a stale
+                # mid-epoch ckpt_e would stay newest across the boundary
+                # and the "at most N steps lost" guarantee breaks
+                or cfg.mid_epoch_save_every > 0
+            ):
                 self._ckpt_io().save(
                     cfg.ckpt_dir, self.state, epoch, cfg.keep_last_ckpts,
                     extra_meta=self._ckpt_meta(),
