@@ -1,12 +1,16 @@
 # Convenience targets; CI / the driver call the underlying commands directly.
 
-.PHONY: test bench csrc clean
+.PHONY: test quick bench csrc clean
 
 csrc:
 	$(MAKE) -C tpu_dist/csrc
 
 test:
 	python -m pytest tests/ -x -q
+
+# <5-min cross-component slice (see tests/conftest.py for the curated set)
+quick:
+	python -m pytest tests/ -m quick -q
 
 bench:
 	python bench.py
