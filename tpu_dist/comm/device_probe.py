@@ -1,0 +1,51 @@
+"""Bounded device discovery — the anti-hang guard every TPU entry point
+shares.
+
+A wedged axon tunnel makes ``jax.devices()`` (PJRT client construction)
+hang forever; a broken plugin registration makes it raise within seconds.
+The two need different messages and different handling, and a plain
+``thread.join(timeout)`` conflates them (an empty result list looks like a
+timeout either way, with the real traceback lost to the daemon thread's
+excepthook). This helper distinguishes the cases once, for ``bench.py``
+and ``__graft_entry__`` both.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+def bounded_device_discovery(timeout_s: float):
+    """``jax.devices()`` with a hang bound.
+
+    Returns the device list on success. Re-raises the probe's OWN
+    exception when discovery failed fast (plugin/registration errors keep
+    their traceback). Raises ``TimeoutError`` when discovery is still
+    blocked after ``timeout_s`` (the wedged-tunnel signature) — the probe
+    thread is a daemon and dies with the process; callers that keep the
+    process alive afterwards must release any machine-wide TPU lock they
+    hold, since the hung probe could still complete the tunnel claim
+    later.
+    """
+    result: list = []
+    error: list = []
+
+    def probe():
+        try:
+            import jax  # noqa: PLC0415
+
+            result.append(jax.devices())
+        except BaseException as e:  # surfaced on the caller's thread
+            error.append(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if result:
+        return result[0]
+    if error:
+        raise error[0]
+    raise TimeoutError(
+        f"device backend failed to initialize within {timeout_s:.0f}s "
+        "(TPU tunnel unreachable?)"
+    )
