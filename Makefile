@@ -1,12 +1,17 @@
 # Convenience targets; CI / the driver call the underlying commands directly.
 
-.PHONY: test quick bench csrc clean
+.PHONY: test quick bench csrc clean lint
 
 csrc:
 	$(MAKE) -C tpu_dist/csrc
 
 test:
 	python -m pytest tests/ -x -q
+
+# Static lint (TD0xx) + jaxpr audit (TD1xx) against the checked-in baseline;
+# non-zero exit on any new violation (docs/analysis.md)
+lint:
+	python -m tpu_dist.analysis --format json
 
 # <5-min cross-component slice (see tests/conftest.py for the curated set)
 quick:

@@ -37,6 +37,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "quick: fast cross-component smoke slice (pytest -m quick)"
     )
+    # slow = multi-minute statistical/convergence runs, excluded from the
+    # tier-1 gate (which runs with -m 'not slow' under a hard timeout)
+    config.addinivalue_line(
+        "markers", "slow: multi-minute runs excluded from the tier-1 gate"
+    )
 
 
 # The quick slice, curated centrally (VERDICT r4 #8: split before the full
@@ -98,5 +103,10 @@ def pytest_collection_modifyitems(config, items):
     import pytest  # noqa: PLC0415
 
     for item in items:
+        # slow-marked tests never join the quick slice, even when their
+        # whole module is listed — the markers would contradict (quick is
+        # the <5-min slice; slow is the >10s excluded-from-timed-gates set)
+        if item.get_closest_marker("slow"):
+            continue
         if any(q in item.nodeid for q in _QUICK):
             item.add_marker(pytest.mark.quick)

@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from tpu_dist.comm.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from tpu_dist.comm import mesh as mesh_lib
@@ -231,10 +231,23 @@ def _ring_flash_fn(mesh, causal, block=16):
     )
 
 
+def _run_or_skip_submesh(fn, *args):
+    """Some jaxlibs cannot lower pallas-interpret inside shard_map on a
+    SUB-mesh (4 of 8 devices): XLA emits a PartitionId instruction it then
+    refuses under SPMD. Full-mesh ring-flash tests cover the numerics; the
+    sub-mesh variants skip on that exact signature instead of failing."""
+    try:
+        return fn(*args)
+    except Exception as e:  # jaxlib.xla_extension.XlaRuntimeError
+        if "PartitionId instruction is not supported" in str(e):
+            pytest.skip("jaxlib cannot lower pallas-interpret on a sub-mesh")
+        raise
+
+
 def test_ring_flash_equals_full_4way():
     mesh = mesh_lib.device_mesh([4], ["seq"], jax.devices()[:4])
     q, k, v = _qkv(s=64, seed=5)
-    out = np.asarray(_ring_flash_fn(mesh, causal=False)(q, k, v))
+    out = np.asarray(_run_or_skip_submesh(_ring_flash_fn(mesh, causal=False), q, k, v))
     ref = np.asarray(A.full_attention(q, k, v))
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
 
@@ -324,7 +337,7 @@ def test_ring_flash_bf16_matches_single_device_flash(causal):
     mesh = mesh_lib.device_mesh([4], ["seq"], jax.devices()[:4])
     q, k, v = (t.astype(jnp.bfloat16) for t in _qkv(s=64, seed=8))
     fn = _ring_flash_fn(mesh, causal=causal)
-    out = np.asarray(fn(q, k, v), dtype=np.float32)
+    out = np.asarray(_run_or_skip_submesh(fn, q, k, v), dtype=np.float32)
     ref = np.asarray(
         flash_attention(q, k, v, causal=causal, block_q=16, block_k=16),
         dtype=np.float32,

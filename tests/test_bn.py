@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from tpu_dist.comm.compat import shard_map
 
 from tpu_dist.comm import mesh as mesh_lib
 from tpu_dist.nn import layers as L

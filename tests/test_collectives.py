@@ -5,7 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from tpu_dist.comm.compat import shard_map
 
 from tpu_dist.comm import collectives as C
 from tpu_dist.comm import mesh as mesh_lib

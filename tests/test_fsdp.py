@@ -5,6 +5,7 @@ here drives the SAME batches through the explicit shard_map DP engine and
 the GSPMD FSDP engine and asserts identical trajectories, while separately
 asserting that the FSDP state really is sharded (the whole point)."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -197,6 +198,8 @@ def test_fsdp_eval_step_sums_contract():
     assert np.isfinite(float(sums["loss"]))
 
 
+@pytest.mark.slow  # >10s e2e: excluded from the timed tier-1 gate; the
+# quick slice keeps a fast representative of this subsystem in the gate
 def test_trainer_fsdp_e2e_with_resume(tmp_path):
     from tpu_dist.config import TrainConfig
     from tpu_dist.train.trainer import Trainer, register_model

@@ -31,9 +31,15 @@ def test_launcher_spawns_and_propagates_success(tmp_path):
 
 
 def test_launcher_propagates_failure():
+    # rank 1 dies with code 3, rank 0 exits clean: the launcher must return
+    # the first non-zero child code. (Keyed off the injected --process_id —
+    # NOT argv[-1], which is the launcher-appended port and made the old
+    # version of this test flip on port numbers ending in 0.)
     rc = launch_main([
         "--nproc", "2", "--devices_per_proc", "1", "--",
-        sys.executable, "-c", "import sys; sys.exit(int(sys.argv[-1][-1]) and 3)",
+        sys.executable, "-c",
+        "import sys; a = sys.argv; "
+        "sys.exit(3 if a[a.index('--process_id') + 1] == '1' else 0)",
     ])
     assert rc == 3
 

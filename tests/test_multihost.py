@@ -7,12 +7,17 @@ feeds its data shard, and the replicated state must come out identical.
 """
 
 import os
+import pytest
 import socket
 import subprocess
 import sys
 
 _HERE = os.path.dirname(__file__)
 _REPO_ROOT = os.path.dirname(os.path.abspath(_HERE))
+
+# set by the first test that discovers this jaxlib's CPU backend cannot run
+# cross-process collectives (one mutable cell, module-session scope)
+_NO_MP_CPU = [False]
 
 
 def _free_port() -> int:
@@ -30,6 +35,8 @@ def _launch_workers(worker_script: str, result_prefix: str, nprocs: int = 2,
     workers exited 0. One place owns the CPU-forcing env recipe (empty
     PALLAS_AXON_POOL_IPS skips the TPU plugin; PYTHONPATH drops the TPU
     sitecustomize) so a future env fix lands once, not per-test."""
+    if _NO_MP_CPU[0]:
+        pytest.skip("CPU backend lacks multiprocess collectives in this jaxlib")
     worker = os.path.join(_HERE, worker_script)
     coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
@@ -47,10 +54,25 @@ def _launch_workers(worker_script: str, result_prefix: str, nprocs: int = 2,
         for i in range(nprocs)
     ]
     outs = []
+    failed = []
     for p in procs:
         out, _ = p.communicate(timeout=240)
         outs.append(out)
-        assert p.returncode == 0, out
+        if p.returncode != 0:
+            failed.append(out)
+    if failed:
+        if any(
+            "Multiprocess computations aren't implemented on the CPU backend"
+            in out
+            for out in failed
+        ):
+            # this jaxlib's CPU backend has no cross-process collectives at
+            # all (newer jaxlibs route them through gloo) — environmental,
+            # not a code failure; remember so sibling tests skip without
+            # paying the two-process boot cost again
+            _NO_MP_CPU[0] = True
+            pytest.skip("CPU backend lacks multiprocess collectives in this jaxlib")
+        raise AssertionError(failed[0])
 
     results = {}
     for out in outs:

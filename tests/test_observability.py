@@ -31,6 +31,8 @@ def test_annotate_step_contextmanager():
         _ = jnp.ones(2) + 1
 
 
+@pytest.mark.slow  # >10s e2e: excluded from the timed tier-1 gate; the
+# quick slice keeps a fast representative of this subsystem in the gate
 def test_trace_writes_profile(tmp_path):
     with trace(str(tmp_path)):
         jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
@@ -59,6 +61,8 @@ def test_check_replicated_detects_divergence():
         check_replicated({"w": arr}, name="params")
 
 
+@pytest.mark.slow  # >10s e2e: excluded from the timed tier-1 gate; the
+# quick slice keeps a fast representative of this subsystem in the gate
 def test_trainer_profile_dir_captures_trace(tmp_path):
     """--profile_dir wraps epoch 0 in the XLA profiler (metrics/profiler.py):
     a TensorBoard-readable xplane capture must land on disk."""

@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from tpu_dist.comm.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from tpu_dist.comm import mesh as mesh_lib

@@ -176,7 +176,7 @@ def test_interleave_rejects_bad_configs():
         from tpu_dist.parallel.pipeline import pipeline_apply_interleaved
 
         import jax.numpy as jnp
-        from jax import shard_map
+        from tpu_dist.comm.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         mesh = mesh_lib.device_mesh([4], ["pipe"], jax.devices()[:4])

@@ -1,7 +1,10 @@
 """Property-based checks of the DistributedSampler invariants."""
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from tpu_dist.data.sampler import DistributedSampler
 

@@ -68,6 +68,8 @@ def test_nan_guard_catches_between_log_steps():
         Trainer(cfg).train_epoch(0)
 
 
+@pytest.mark.slow  # >10s e2e: excluded from the timed tier-1 gate; the
+# quick slice keeps a fast representative of this subsystem in the gate
 def test_nan_guard_covers_fused_epoch():
     cfg = TrainConfig(
         dataset="synthetic", model="tiny_resnet_g", num_classes=10,

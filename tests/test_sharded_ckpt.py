@@ -169,38 +169,49 @@ def test_resume_format_mismatch_is_loud(tmp_path):
         Trainer(cfg.replace(resume=True, sharded_ckpt=True))
 
 
-from hypothesis import given, settings, strategies as st  # noqa: E402
+try:  # optional dep: only the property-based case needs it
+    from hypothesis import given, settings, strategies as st  # noqa: E402
+except ImportError:
+    st = None
 
+if st is None:
 
-@settings(max_examples=25, deadline=None)
-@given(
-    rows=st.integers(1, 40),
-    cols=st.integers(1, 12),
-    shard_rows=st.booleans(),
-    seed=st.integers(0, 100),
-)
-def test_sharded_roundtrip_property(tmp_path_factory, rows, cols, shard_rows, seed):
-    """Any (shape, sharding) combination JAX can place round-trips
-    bit-exact through the shard-piece format (JAX refuses indivisible
-    NamedShardings outright, so divisible-sharded and replicated leaves
-    are the whole space)."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    @pytest.mark.skip(reason="hypothesis not installed")
+    def test_sharded_roundtrip_property():
+        """Stub so the missing property coverage shows up as a SKIP in
+        reports instead of silently vanishing."""
 
-    tmp_path = tmp_path_factory.mktemp("shards")
-    mesh = mesh_lib.data_parallel_mesh()
-    rng = np.random.default_rng(seed)
-    w = rng.normal(size=(rows, cols)).astype(np.float32)
-    n_dev = int(mesh.devices.size)
-    spec = P("data") if (shard_rows and rows % n_dev == 0) else P()
-    params = {"w": jax.device_put(w, NamedSharding(mesh, spec))}
-    state = TrainState(
-        params=params, bn_state={}, opt_state={},
-        step=jax.device_put(np.asarray(seed, np.int32), NamedSharding(mesh, P())),
+else:
+
+    @settings(max_examples=25, deadline=None)
+    @given(
+        rows=st.integers(1, 40),
+        cols=st.integers(1, 12),
+        shard_rows=st.booleans(),
+        seed=st.integers(0, 100),
     )
-    mpath = ckpt_lib.save_sharded(str(tmp_path), state, 0)
-    restored = ckpt_lib.restore_sharded(mpath, state)
-    np.testing.assert_array_equal(np.asarray(restored.params["w"]), w)
-    assert int(np.asarray(restored.step)) == seed
+    def test_sharded_roundtrip_property(tmp_path_factory, rows, cols, shard_rows, seed):
+        """Any (shape, sharding) combination JAX can place round-trips
+        bit-exact through the shard-piece format (JAX refuses indivisible
+        NamedShardings outright, so divisible-sharded and replicated leaves
+        are the whole space)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        tmp_path = tmp_path_factory.mktemp("shards")
+        mesh = mesh_lib.data_parallel_mesh()
+        rng = np.random.default_rng(seed)
+        w = rng.normal(size=(rows, cols)).astype(np.float32)
+        n_dev = int(mesh.devices.size)
+        spec = P("data") if (shard_rows and rows % n_dev == 0) else P()
+        params = {"w": jax.device_put(w, NamedSharding(mesh, spec))}
+        state = TrainState(
+            params=params, bn_state={}, opt_state={},
+            step=jax.device_put(np.asarray(seed, np.int32), NamedSharding(mesh, P())),
+        )
+        mpath = ckpt_lib.save_sharded(str(tmp_path), state, 0)
+        restored = ckpt_lib.restore_sharded(mpath, state)
+        np.testing.assert_array_equal(np.asarray(restored.params["w"]), w)
+        assert int(np.asarray(restored.step)) == seed
 
 
 def test_zero1_sharded_ckpt_resume(tmp_path):

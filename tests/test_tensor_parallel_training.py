@@ -68,7 +68,7 @@ def test_dp_tp_training_matches_single_device():
 def test_tp_forward_parity():
     """TP-sharded forward ≡ dense forward (eval-path insurance)."""
     import jax.numpy as jnp
-    from jax import shard_map
+    from tpu_dist.comm.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     model = _model()

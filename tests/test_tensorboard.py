@@ -2,6 +2,7 @@
 files the OFFICIAL TensorBoard reader parses — record framing (masked
 CRC32C), protobuf wire format, and values all checked by round-trip."""
 
+import pytest
 import numpy as np
 
 from tpu_dist.metrics.tensorboard import SummaryWriter, _crc32c
@@ -35,6 +36,8 @@ def test_roundtrip_via_tensorboard_reader(tmp_path):
     assert top1.step == 4 and abs(top1.value - 73.25) < 1e-4
 
 
+@pytest.mark.slow  # >10s e2e: excluded from the timed tier-1 gate; the
+# quick slice keeps a fast representative of this subsystem in the gate
 def test_trainer_writes_tensorboard(tmp_path):
     from tensorboard.backend.event_processing import event_accumulator
 

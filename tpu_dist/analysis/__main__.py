@@ -1,0 +1,158 @@
+"""CLI: ``python -m tpu_dist.analysis`` — lint + jaxpr audit, gate-ready.
+
+Exit codes: 0 clean (after suppressions + baseline), 1 violations,
+2 internal error. ``--format json`` emits one machine-readable object for
+the CI gate; text mode prints ``file:line:col: TDxxx message`` lines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# The jaxpr layer traces shard_map programs, which need a multi-device
+# mesh: force the 8-device emulated CPU backend BEFORE jax initializes
+# (same mechanism as tests/conftest.py).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+from tpu_dist.analysis import baseline as baseline_lib  # noqa: E402
+from tpu_dist.analysis.lint import lint_paths  # noqa: E402
+from tpu_dist.analysis.rules import RULES  # noqa: E402
+
+DEFAULT_BASELINE = "tools/analysis_baseline.json"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_dist.analysis",
+        description="distributed-training lint (TD0xx) + jaxpr audit (TD1xx)",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        default=["tpu_dist"],
+        help="files/dirs to lint (default: tpu_dist)",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--baseline",
+        default=None,
+        help=f"baseline file (default: {DEFAULT_BASELINE} when it exists)",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="accept current findings into the baseline file and exit 0",
+    )
+    ap.add_argument("--no-lint", action="store_true", help="skip the AST lint layer")
+    ap.add_argument(
+        "--no-jaxpr", action="store_true", help="skip the jaxpr audit layer"
+    )
+    ap.add_argument(
+        "--case",
+        action="append",
+        help="run only this jaxpr audit case (repeatable)",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES.values():
+            print(f"{r.id}  {r.name}\n      {r.summary}")
+        return 0
+
+    violations = []
+    report: dict = {}
+    if not args.no_lint:
+        try:
+            violations.extend(lint_paths(args.paths))
+        except FileNotFoundError as e:
+            print(f"tpu_dist.analysis: {e}", file=sys.stderr)
+            return 2
+    if not args.no_jaxpr:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from tpu_dist.analysis.jaxpr_audit import audit_all, registered_cases
+
+        if args.case:
+            unknown = sorted(set(args.case) - set(registered_cases()))
+            if unknown:
+                print(
+                    f"tpu_dist.analysis: unknown audit case(s) {unknown}; "
+                    f"registered: {registered_cases()}",
+                    file=sys.stderr,
+                )
+                return 2
+        jaxpr_report, jaxpr_violations = audit_all(names=args.case)
+        report["jaxpr"] = jaxpr_report
+        violations.extend(jaxpr_violations)
+
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None
+    )
+    if args.write_baseline:
+        if args.no_lint or args.no_jaxpr or args.case or args.paths != ["tpu_dist"]:
+            # a partial run would REPLACE the file with only this run's
+            # findings, silently dropping accepted entries from the layers
+            # or paths that did not execute
+            print(
+                "tpu_dist.analysis: refusing --write-baseline on a partial "
+                "run (--no-lint/--no-jaxpr/--case/custom paths); run the "
+                "full analyzer to regenerate the baseline",
+                file=sys.stderr,
+            )
+            return 2
+        path = args.baseline or DEFAULT_BASELINE
+        baseline_lib.write(violations, path)
+        print(f"wrote {len(violations)} accepted finding(s) to {path}")
+        return 0
+
+    stale: list = []
+    if baseline_path:
+        violations, stale = baseline_lib.apply(
+            violations, baseline_lib.load(baseline_path)
+        )
+
+    if args.format == "json":
+        out = {
+            "violations": [v.to_json() for v in violations],
+            "stale_baseline_entries": stale,
+            "jaxpr_report": report.get("jaxpr", {}),
+            "counts": {"new": len(violations), "stale_baseline": len(stale)},
+        }
+        print(json.dumps(out, indent=2))
+    else:
+        for v in violations:
+            print(v.format_text())
+        for e in stale:
+            print(
+                f"stale baseline entry (no longer produced): "
+                f"{e.get('rule')} {e.get('path')} {e.get('snippet')!r}"
+            )
+        n = len(violations)
+        print(
+            f"tpu_dist.analysis: {n} new violation(s)"
+            + (f", {len(stale)} stale baseline entr(ies)" if stale else "")
+        )
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except BrokenPipeError:
+        sys.exit(0)  # output piped into head etc.
+    except BaseException:  # noqa: BLE001 — exit 2 distinguishes tool crashes
+        import traceback
+
+        traceback.print_exc()
+        sys.exit(2)

@@ -1,0 +1,329 @@
+"""Layer 2 — jaxpr audit of the compiled train steps (rules TD101-TD103).
+
+Where Layer 1 reads *source*, this layer reads the *program*: each
+registered audit case builds a real step function on an emulated CPU mesh,
+traces it abstractly (``jax.make_jaxpr`` — no device cycles, no
+compilation), and walks the closed jaxpr:
+
+* **TD101** — collective ops (``psum``/``all_gather``/``psum_scatter``/
+  ``ppermute``/``all_to_all``) are counted and asserted against the
+  parallelism config's budget. The budget encodes real invariants: grad
+  accumulation must NOT add collectives (torch's ``no_sync`` contract —
+  the single post-scan pmean), and ZeRO-1 must replace the grad allreduce
+  with exactly one reduce-scatter + one all-gather (arXiv:2004.13336).
+* **TD102** — ``device_put`` transfer ops inside the step are host↔device
+  traffic on the hot path; the budget is zero.
+* **TD103** — bf16→f32 ``convert_element_type`` ops in the mixed-precision
+  case are counted against the number the bf16 policy declares (params
+  cast transpose + the f32 metric readouts). One more means some op is
+  silently promoting — f32 math and double the bytes where bf16 was asked
+  for (the promotion-creep failure mode of arXiv:2011.03641 §4).
+
+Counts are per-*equation*: ``lax.pmean`` over a whole grad pytree emits ONE
+multi-operand ``psum`` eqn, so budgets stay stable as models grow leaves.
+
+Register additional cases with :func:`register_audit_case` (builders get
+the mesh, return ``(fn, example_args, CollectiveBudget)``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Callable, Optional
+
+from tpu_dist.analysis.rules import Violation
+
+COLLECTIVE_PRIMS = {
+    "psum",
+    "pmin",
+    "pmax",
+    "all_gather",
+    "all_to_all",
+    "ppermute",
+    "pgather",
+    "psum_scatter",
+    "reduce_scatter",
+}
+TRANSFER_PRIMS = {"device_put"}
+
+
+@dataclasses.dataclass
+class CollectiveBudget:
+    """Expected jaxpr-op counts for one step under one parallelism config.
+
+    ``collectives`` maps primitive name → exact expected eqn count (prims
+    absent from the map must not appear at all). ``transfers`` is the
+    allowed ``device_put`` count (0 on any sane hot path). ``bf16_to_f32``
+    is the declared number of bf16→f32 converts, or None to skip TD103
+    (pure-f32 cases)."""
+
+    collectives: dict[str, int]
+    transfers: int = 0
+    bf16_to_f32: Optional[int] = None
+
+
+@dataclasses.dataclass
+class AuditCase:
+    name: str
+    # builder(mesh) -> (step_fn, example_args_tuple, CollectiveBudget)
+    builder: Callable
+
+
+_CASES: dict[str, AuditCase] = {}
+
+
+def register_audit_case(name: str, builder: Callable) -> None:
+    _CASES[name] = AuditCase(name, builder)
+
+
+def registered_cases() -> list[str]:
+    return sorted(_CASES)
+
+
+# --------------------------------------------------------------------------
+# jaxpr walking
+# --------------------------------------------------------------------------
+
+
+def _jaxpr_classes():
+    """(ClosedJaxpr, Jaxpr) wherever this jax keeps them — ``jax.core`` up
+    to 0.5.x, ``jax.extend.core`` afterwards."""
+    try:
+        from jax.extend.core import ClosedJaxpr, Jaxpr  # type: ignore
+    except ImportError:
+        from jax.core import ClosedJaxpr, Jaxpr  # type: ignore
+    return ClosedJaxpr, Jaxpr
+
+
+def _sub_jaxprs(params: dict):
+    ClosedJaxpr, Jaxpr = _jaxpr_classes()
+    for v in params.values():
+        vals = v if isinstance(v, (list, tuple)) else [v]
+        for item in vals:
+            if isinstance(item, ClosedJaxpr):
+                yield item.jaxpr
+            elif isinstance(item, Jaxpr):
+                yield item
+
+
+def _walk_eqns(jaxpr, mult: int = 1):
+    """Yield ``(eqn, multiplicity)`` — ops inside a ``scan`` body run once
+    per trip, so their counts are multiplied by the trip count. Without
+    this, a grad pmean accidentally moved INSIDE the accumulation scan
+    (the exact no_sync violation TD101 exists to catch) would count the
+    same as the single post-scan reduce."""
+    for eqn in jaxpr.eqns:
+        yield eqn, mult
+        sub_mult = mult
+        if eqn.primitive.name == "scan":
+            sub_mult = mult * int(eqn.params.get("length", 1))
+        for sub in _sub_jaxprs(eqn.params):
+            yield from _walk_eqns(sub, sub_mult)
+
+
+def trace_counts(fn, *args) -> dict:
+    """Abstractly trace ``fn(*args)`` and tally the audited op classes."""
+    import jax
+    import jax.numpy as jnp
+
+    closed = jax.make_jaxpr(fn)(*args)
+    collectives: Counter = Counter()
+    transfers = 0
+    bf16_to_f32 = 0
+    for eqn, mult in _walk_eqns(closed.jaxpr):
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS:
+            collectives[name] += mult
+        elif name in TRANSFER_PRIMS:
+            transfers += mult
+        elif name == "convert_element_type":
+            (invar,) = eqn.invars
+            src = getattr(getattr(invar, "aval", None), "dtype", None)
+            dst = eqn.params.get("new_dtype")
+            if src == jnp.bfloat16 and dst == jnp.float32:
+                bf16_to_f32 += mult
+    return {
+        "collectives": dict(sorted(collectives.items())),
+        "transfers": transfers,
+        "bf16_to_f32": bf16_to_f32,
+    }
+
+
+# --------------------------------------------------------------------------
+# The default registered cases: the data-parallel train-step family.
+# --------------------------------------------------------------------------
+
+
+class _AuditMLP:
+    """BN-free two-layer MLP: the smallest model with a multi-leaf param
+    tree (4 leaves) that still exercises the full step machinery."""
+
+    in_dim, width, classes = 12, 16, 10
+
+    def init(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        k1, k2 = jax.random.split(key)
+        params = {
+            "w1": jax.random.normal(k1, (self.in_dim, self.width), jnp.float32) * 0.1,
+            "b1": jnp.zeros((self.width,), jnp.float32),
+            "w2": jax.random.normal(k2, (self.width, self.classes), jnp.float32) * 0.1,
+            "b2": jnp.zeros((self.classes,), jnp.float32),
+        }
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, axis_name=None, **kw):
+        import jax.numpy as jnp
+
+        x = x.reshape(x.shape[0], -1)
+        h = jnp.maximum(x @ params["w1"] + params["b1"], 0.0)
+        return h @ params["w2"] + params["b2"], state
+
+
+def _dp_setup(mesh, **step_kwargs):
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.train.optim import SGD
+    from tpu_dist.train.state import TrainState
+    from tpu_dist.train.step import init_sharded_opt_state, make_train_step
+
+    model = _AuditMLP()
+    params, bn = model.init(jax.random.PRNGKey(0))
+    opt = SGD(momentum=0.9, weight_decay=1e-4)
+    if step_kwargs.get("shard_weight_update"):
+        opt_state = init_sharded_opt_state(params, mesh)
+    else:
+        opt_state = opt.init(params)
+    state = TrainState(params, bn, opt_state, jnp.zeros((), jnp.int32))
+    step = make_train_step(model.apply, opt, mesh, sync_bn=False, **step_kwargs)
+    n = mesh.devices.size
+    batch = 8 * n  # 8 per device: divisible by the accum case's K=4
+    images = jax.ShapeDtypeStruct((batch, 2, 2, 3), jnp.float32)
+    labels = jax.ShapeDtypeStruct((batch,), jnp.int32)
+    lr = jax.ShapeDtypeStruct((), jnp.float32)
+    return step, (state, images, labels, lr)
+
+
+# The plain data-parallel step's collective inventory (per compiled step):
+#   psum x4: grad-tree pmean (1 multi-operand eqn), metric loss pmean,
+#            acc1 correct-count psum, acc5 correct-count psum
+#            (the `psum(1, axis)` device-count terms fold to constants
+#            at trace time — no eqn).
+_DP_BUDGET = {"psum": 4}
+# ZeRO-1 swaps the grad psum for reduce-scatter + param all-gather
+# (arXiv:2004.13336): 3 metric psums remain. (lax.psum_scatter lowers to
+# the `reduce_scatter` primitive.)
+_ZERO1_BUDGET = {"psum": 3, "reduce_scatter": 1, "all_gather": 1}
+# bf16 compute declares: 4 bf16→f32 converts from the params-cast transpose
+# (one per param leaf, rebuilding f32 grads) + 1 logits→f32 for metrics
+# + 1 loss→f32 for the metric pmean.
+_BF16_CONVERTS = 6
+
+
+def _case_dp_sgd(mesh):
+    fn, args = _dp_setup(mesh)
+    return fn, args, CollectiveBudget(dict(_DP_BUDGET), bf16_to_f32=None)
+
+
+def _case_dp_sgd_accum(mesh):
+    # torch no_sync contract: K local sub-steps, ONE cross-replica reduce —
+    # the budget is IDENTICAL to the K=1 step.
+    fn, args = _dp_setup(mesh, grad_accum_steps=4)
+    return fn, args, CollectiveBudget(dict(_DP_BUDGET), bf16_to_f32=None)
+
+
+def _case_dp_bf16(mesh):
+    import jax.numpy as jnp
+
+    fn, args = _dp_setup(mesh, compute_dtype=jnp.bfloat16)
+    return fn, args, CollectiveBudget(dict(_DP_BUDGET), bf16_to_f32=_BF16_CONVERTS)
+
+
+def _case_zero1_sgd(mesh):
+    fn, args = _dp_setup(mesh, shard_weight_update=True)
+    return fn, args, CollectiveBudget(dict(_ZERO1_BUDGET), bf16_to_f32=None)
+
+
+register_audit_case("dp_sgd", _case_dp_sgd)
+register_audit_case("dp_sgd_accum4", _case_dp_sgd_accum)
+register_audit_case("dp_bf16", _case_dp_bf16)
+register_audit_case("zero1_sgd", _case_zero1_sgd)
+
+
+# --------------------------------------------------------------------------
+# Driving + budget comparison
+# --------------------------------------------------------------------------
+
+
+def audit_case(name: str, mesh=None) -> tuple[dict, list[Violation]]:
+    from tpu_dist.comm import mesh as mesh_lib
+
+    if name not in _CASES:
+        raise ValueError(
+            f"unknown audit case {name!r}; registered: {registered_cases()}"
+        )
+    case = _CASES[name]
+    m = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+    fn, args, budget = case.builder(m)
+    counts = trace_counts(fn, *args)
+    return counts, _compare(name, counts, budget)
+
+
+def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
+    """Run every (or the named) registered case. Returns
+    ``(report, violations)`` where report maps case → op counts."""
+    report: dict = {}
+    violations: list[Violation] = []
+    for name in names if names is not None else registered_cases():
+        counts, vs = audit_case(name, mesh)
+        report[name] = counts
+        violations.extend(vs)
+    return report, violations
+
+
+def _compare(name: str, counts: dict, budget: CollectiveBudget) -> list[Violation]:
+    out: list[Violation] = []
+    path = f"<jaxpr:{name}>"
+    actual = counts["collectives"]
+    for prim in sorted(set(actual) | set(budget.collectives)):
+        want, got = budget.collectives.get(prim, 0), actual.get(prim, 0)
+        if want != got:
+            out.append(
+                Violation(
+                    "TD101",
+                    path,
+                    0,
+                    f"{prim}: expected {want} per step, jaxpr has {got} — "
+                    "the compiled step's collective inventory drifted from "
+                    "the parallelism config's budget",
+                    snippet=f"{prim}:{got}",
+                )
+            )
+    if counts["transfers"] > budget.transfers:
+        out.append(
+            Violation(
+                "TD102",
+                path,
+                0,
+                f"{counts['transfers']} device_put transfer op(s) inside "
+                f"the compiled step (budget {budget.transfers}) — "
+                "host↔device traffic on the hot path",
+                snippet=f"device_put:{counts['transfers']}",
+            )
+        )
+    if budget.bf16_to_f32 is not None and counts["bf16_to_f32"] != budget.bf16_to_f32:
+        out.append(
+            Violation(
+                "TD103",
+                path,
+                0,
+                f"{counts['bf16_to_f32']} bf16→f32 converts, mixed-precision "
+                f"policy declares {budget.bf16_to_f32} — an op is implicitly "
+                "promoting to f32",
+                snippet=f"bf16_to_f32:{counts['bf16_to_f32']}",
+            )
+        )
+    return out

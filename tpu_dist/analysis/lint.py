@@ -1,0 +1,502 @@
+"""Layer 1 — AST lint for TPU-hostile idioms (rules TD001-TD005).
+
+Pure ``ast`` walking, no jax import, so it runs anywhere in milliseconds.
+The interesting part is *traced-context detection*: TD001/TD005 only apply
+inside functions that run under a JAX trace. A function is considered
+traced when it is
+
+* decorated with / passed to a trace entry point (``jax.jit``,
+  ``shard_map``, ``jax.grad``, ``lax.scan``, ... — ``TRACE_ENTRY_CALLS``),
+  including through ``functools.partial``;
+* defined lexically inside a traced function (the factory pattern:
+  ``make_train_step`` is host code, its nested ``step_local`` is traced); or
+* called by name from a traced function in the same module (closure over
+  the local call graph, computed to a fixpoint).
+
+This is a heuristic, not a proof — model ``apply`` callbacks crossing
+module boundaries are invisible to it — but it covers every idiom the
+package actually uses, and misses cost only a lint gap, never a false
+build break.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, Optional
+
+from tpu_dist.analysis.rules import (
+    COMPAT_MODULE_SUFFIX,
+    FRAGILE_IMPORTS,
+    HOST_SYNC_BUILTINS,
+    HOST_SYNC_CALLS,
+    HOST_SYNC_METHODS,
+    HOT_FACTORY_REGEX,
+    LOG_METHODS,
+    LOGGERISH_NAMES,
+    NONDETERMINISM_CALLS,
+    NONDETERMINISM_PREFIXES,
+    RANK_CALL_SUFFIXES,
+    RANK_VAR_NAMES,
+    TD002_EXEMPT_PARTS,
+    TRACE_ENTRY_CALLS,
+    Violation,
+)
+
+_SUPPRESS_RE = re.compile(r"#\s*tpu-dist:\s*ignore(?:\[([A-Za-z0-9,\s]+)\])?")
+_HOT_RE = re.compile(HOT_FACTORY_REGEX)
+_PRIMARY_NAMES = {"is_primary", "is_main", "is_main_process", "main_process"}
+
+
+def lint_paths(paths: Iterable[str], root: Optional[str] = None) -> list[Violation]:
+    """Lint every ``.py`` under ``paths``; returns suppression-filtered
+    violations with repo-relative file names."""
+    root = os.path.abspath(root or os.getcwd())
+    out: list[Violation] = []
+    for path in paths:
+        path = os.path.abspath(path)
+        if not os.path.exists(path):
+            # a missing path must be loud: os.walk would iterate nothing
+            # and the gate would report a false-green "0 violations"
+            raise FileNotFoundError(f"lint path does not exist: {path}")
+        if os.path.isfile(path):
+            out.extend(lint_file(path, root))
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.extend(lint_file(os.path.join(dirpath, fn), root))
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out
+
+
+def lint_file(path: str, root: Optional[str] = None) -> list[Violation]:
+    root = os.path.abspath(root or os.getcwd())
+    rel = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    return lint_source(source, rel)
+
+
+def lint_source(source: str, rel_path: str) -> list[Violation]:
+    """Lint one file's source. ``rel_path`` is used for reporting AND for
+    path-scoped rules (TD004's compat-module exemption)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Violation("TD000", rel_path, e.lineno or 0, f"syntax error: {e.msg}")]
+    lines = source.splitlines()
+    lint = _FileLint(tree, lines, rel_path)
+    out = [v for v in lint.run() if not lint.suppressed(v)]
+    out.sort(key=lambda v: (v.line, v.col, v.rule))
+    return out
+
+
+class _FileLint:
+    def __init__(self, tree: ast.Module, lines: list[str], rel_path: str):
+        self.tree = tree
+        self.lines = lines
+        self.rel_path = rel_path
+        self.parent: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+        self.aliases = self._collect_aliases()
+        self.funcs_by_name: dict[str, list[ast.AST]] = {}
+        self.all_funcs: list[ast.AST] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.all_funcs.append(node)
+                self.funcs_by_name.setdefault(node.name, []).append(node)
+        self.traced = self._find_traced()
+        self.suppressions = self._collect_suppressions()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _collect_aliases(self) -> dict[str, str]:
+        aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for a in node.names:
+                    if a.name != "*":
+                        aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted path of a Name/Attribute chain with import aliases
+        substituted: ``np.random.default_rng`` → ``numpy.random.default_rng``."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        head = self.aliases.get(parts[0], parts[0])
+        return ".".join([head] + parts[1:])
+
+    def _collect_suppressions(self) -> dict[int, Optional[set]]:
+        """Map line → suppressed rule ids (None = all). A marker on a code
+        line covers that line; a marker inside a comment block covers the
+        next statement line (so multi-line explanations work)."""
+        sup: dict[int, Optional[set]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            ids_str = m.group(1)
+            ids = {s.strip() for s in ids_str.split(",")} if ids_str else None
+            targets = [i]
+            if line.strip().startswith("#"):
+                j = i + 1
+                while j <= len(self.lines) and (
+                    not self.lines[j - 1].strip()
+                    or self.lines[j - 1].strip().startswith("#")
+                ):
+                    j += 1
+                if j <= len(self.lines):
+                    targets.append(j)
+            for t in targets:
+                if ids is None or sup.get(t, set()) is None:
+                    sup[t] = None
+                else:
+                    sup[t] = set(sup.get(t) or set()) | ids
+        return sup
+
+    def suppressed(self, v: Violation) -> bool:
+        ids = self.suppressions.get(v.line, False)
+        if ids is False:
+            return False
+        return ids is None or v.rule in ids
+
+    def _snippet(self, node: ast.AST) -> str:
+        line = getattr(node, "lineno", 0)
+        return self.lines[line - 1].strip() if 0 < line <= len(self.lines) else ""
+
+    def _violation(self, rule: str, node: ast.AST, message: str) -> Violation:
+        return Violation(
+            rule,
+            self.rel_path,
+            getattr(node, "lineno", 0),
+            message,
+            col=getattr(node, "col_offset", 0),
+            snippet=self._snippet(node),
+        )
+
+    # -- traced-context detection -----------------------------------------
+
+    def _is_trace_entry(self, func_expr: ast.AST) -> bool:
+        resolved = self.resolve(func_expr)
+        if resolved is None:
+            return False
+        if resolved in TRACE_ENTRY_CALLS:
+            return True
+        # bare names that came from `from jax import jit` etc. resolve above;
+        # accept any compat-module shard_map re-export
+        return resolved.endswith(".shard_map")
+
+    def _find_traced(self) -> set:
+        traced: set = set()
+        # roots: decorators and direct references in trace-entry calls
+        for fn in self.all_funcs:
+            for dec in fn.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if self._is_trace_entry(target):
+                    traced.add(fn)
+                elif (
+                    isinstance(dec, ast.Call)
+                    and self.resolve(dec.func) == "functools.partial"
+                    and dec.args
+                    and self._is_trace_entry(dec.args[0])
+                ):
+                    traced.add(fn)
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call) and self._is_trace_entry(node.func)):
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    for fn in self.funcs_by_name.get(arg.id, []):
+                        traced.add(fn)
+                elif isinstance(arg, ast.Lambda):
+                    traced.add(arg)
+        # closure: lexically-nested defs + module-local call graph
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(traced):
+                for sub in ast.walk(fn):
+                    if sub is fn:
+                        continue
+                    if isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                    ) and sub not in traced:
+                        traced.add(sub)
+                        changed = True
+                    if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                        for callee in self.funcs_by_name.get(sub.func.id, []):
+                            if callee not in traced:
+                                traced.add(callee)
+                                changed = True
+        return traced
+
+    # -- rank-0 guard recognition (TD002) ---------------------------------
+
+    def _is_rank_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Call):
+            resolved = self.resolve(node.func) or ""
+            return resolved.split(".")[-1] in RANK_CALL_SUFFIXES
+        if isinstance(node, ast.Name):
+            return node.id in RANK_VAR_NAMES
+        if isinstance(node, ast.Attribute):
+            return node.attr in RANK_VAR_NAMES
+        return False
+
+    def _is_primary_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Call):
+            resolved = self.resolve(node.func) or ""
+            return resolved.split(".")[-1] in _PRIMARY_NAMES
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            name = node.id if isinstance(node, ast.Name) else node.attr
+            return name in _PRIMARY_NAMES
+        return False
+
+    def _test_polarity(self, test: ast.AST) -> Optional[bool]:
+        """True = test passes only on rank 0; False = only on rank != 0;
+        None = not a rank test. Handles ``== 0``/``!= 0``/``> 0``, bare
+        truthiness, ``not`` inversion, ``is_primary()`` spellings, and
+        ``and``-conjunctions containing a rank test."""
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            inner = self._test_polarity(test.operand)
+            return None if inner is None else not inner
+        if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+            for sub in test.values:
+                pol = self._test_polarity(sub)
+                if pol is not None:
+                    return pol  # rank0 AND x still implies rank0 when true
+            return None
+        if self._is_primary_expr(test):
+            return True
+        if self._is_rank_expr(test):
+            return False  # `if rank:` is true only off rank 0
+        if isinstance(test, ast.Compare) and len(test.ops) == 1:
+            left, op, right = test.left, test.ops[0], test.comparators[0]
+            if isinstance(right, ast.Constant) and right.value == 0:
+                rank_side = left
+            elif isinstance(left, ast.Constant) and left.value == 0:
+                rank_side, op = right, _flip(op)
+            else:
+                return None
+            if not self._is_rank_expr(rank_side):
+                return None
+            if isinstance(op, ast.Eq):
+                return True
+            if isinstance(op, (ast.NotEq, ast.Gt)):
+                return False
+        return None
+
+    def _is_rank0_guarded(self, node: ast.AST) -> bool:
+        # (a) ancestor `if` taking the rank-0 branch
+        child = node
+        anc = self.parent.get(node)
+        while anc is not None:
+            if isinstance(anc, ast.If):
+                pol = self._test_polarity(anc.test)
+                if pol is not None:
+                    in_body = any(child is s for s in anc.body)
+                    in_orelse = any(child is s for s in anc.orelse)
+                    if (pol and in_body) or (not pol and in_orelse):
+                        return True
+            child, anc = anc, self.parent.get(anc)
+        # (b) early-return guard earlier in the enclosing function:
+        #     `if rank != 0: return` before this statement
+        fn = self._enclosing_function(node)
+        if fn is not None:
+            for stmt in fn.body:
+                if getattr(stmt, "lineno", 10**9) >= getattr(node, "lineno", 0):
+                    break
+                if (
+                    isinstance(stmt, ast.If)
+                    and self._test_polarity(stmt.test) is False
+                    and any(isinstance(s, (ast.Return, ast.Raise)) for s in stmt.body)
+                    and not stmt.orelse
+                ):
+                    return True
+        return False
+
+    def _enclosing_function(self, node: ast.AST):
+        anc = self.parent.get(node)
+        while anc is not None:
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+            anc = self.parent.get(anc)
+        return None
+
+    # -- the rules ---------------------------------------------------------
+
+    def run(self) -> list[Violation]:
+        out: list[Violation] = []
+        seen: set = set()
+
+        def emit(rule: str, node: ast.AST, msg: str) -> None:
+            key = (rule, getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+            if key not in seen:
+                seen.add(key)
+                out.append(self._violation(rule, node, msg))
+
+        self._check_imports(emit)
+        for fn in self.traced:
+            self._check_traced_body(fn, emit)
+        self._check_io(emit)
+        self._check_jit_donate(emit)
+        return out
+
+    def _check_imports(self, emit) -> None:  # TD004
+        if self.rel_path.endswith(COMPAT_MODULE_SUFFIX):
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                banned = FRAGILE_IMPORTS.get(node.module)
+                for a in node.names:
+                    if (banned and (a.name in banned or "*" in banned)) or (
+                        FRAGILE_IMPORTS.get(f"{node.module}.{a.name}")
+                    ):
+                        emit(
+                            "TD004",
+                            node,
+                            f"`from {node.module} import {a.name}` moved between "
+                            "JAX releases; import it from tpu_dist.comm.compat",
+                        )
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in FRAGILE_IMPORTS and "*" in FRAGILE_IMPORTS[a.name]:
+                        emit(
+                            "TD004",
+                            node,
+                            f"`import {a.name}` moved between JAX releases; "
+                            "use tpu_dist.comm.compat",
+                        )
+
+    def _check_traced_body(self, fn: ast.AST, emit) -> None:  # TD001 / TD005
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = self.resolve(node.func)
+            if resolved in HOST_SYNC_CALLS:
+                emit("TD001", node, f"`{resolved}` forces a host sync under trace")
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in HOST_SYNC_METHODS
+                and not node.args
+            ):
+                emit(
+                    "TD001",
+                    node,
+                    f"`.{node.func.attr}()` forces a host sync under trace",
+                )
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id in HOST_SYNC_BUILTINS
+                and node.args
+                and not isinstance(node.args[0], ast.Constant)
+            ):
+                emit(
+                    "TD001",
+                    node,
+                    f"`{node.func.id}()` on a traced value blocks on device "
+                    "readback (host sync)",
+                )
+            if resolved is not None and (
+                resolved in NONDETERMINISM_CALLS
+                or resolved.startswith(NONDETERMINISM_PREFIXES)
+            ):
+                emit(
+                    "TD005",
+                    node,
+                    f"`{resolved}` is evaluated ONCE at trace time and baked "
+                    "into the program; use jax.random / pass values in",
+                )
+
+    def _check_io(self, emit) -> None:  # TD002
+        if any(part in self.rel_path for part in TD002_EXEMPT_PARTS):
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = self._io_kind(node)
+            if kind is None or self._is_rank0_guarded(node):
+                continue
+            emit(
+                "TD002",
+                node,
+                f"unguarded {kind} runs on EVERY process; wrap in "
+                "`if process_index() == 0` (or rank0_print/get_logger)",
+            )
+
+    def _io_kind(self, node: ast.Call) -> Optional[str]:
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id == "print":
+                return "print()"
+            if func.id == "open":
+                mode = None
+                if len(node.args) >= 2:
+                    mode = node.args[1]
+                for k in node.keywords:
+                    if k.arg == "mode":
+                        mode = k.value
+                if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+                    if any(c in mode.value for c in "wax+"):
+                        return f"open(mode={mode.value!r}) file write"
+            return None
+        if isinstance(func, ast.Attribute):
+            if func.attr in ("write_text", "write_bytes"):
+                return f".{func.attr}() file write"
+            if func.attr in LOG_METHODS:
+                resolved = self.resolve(func.value) or ""
+                base = func.value
+                basename = (
+                    base.id
+                    if isinstance(base, ast.Name)
+                    else base.attr if isinstance(base, ast.Attribute) else ""
+                )
+                if resolved == "logging" or resolved.startswith("logging."):
+                    return f"logging.{func.attr}()"
+                if any(t in basename.lower() for t in LOGGERISH_NAMES):
+                    return f"{basename}.{func.attr}()"
+        return None
+
+    def _check_jit_donate(self, emit) -> None:  # TD003
+        for node in ast.walk(self.tree):
+            if not (
+                isinstance(node, ast.Call) and self.resolve(node.func) == "jax.jit"
+            ):
+                continue
+            kwargs = {k.arg for k in node.keywords}
+            if kwargs & {"donate_argnums", "donate_argnames"}:
+                continue
+            fn = self._enclosing_function(node)
+            if fn is None or not _HOT_RE.match(fn.name):
+                continue
+            emit(
+                "TD003",
+                node,
+                f"jax.jit inside hot-path factory `{fn.name}` without "
+                "donate_argnums: the old TrainState stays live across the "
+                "update (2x peak HBM)",
+            )
+
+
+def _flip(op: ast.cmpop) -> ast.cmpop:
+    if isinstance(op, ast.Gt):
+        return ast.Lt()
+    if isinstance(op, ast.Lt):
+        return ast.Gt()
+    return op

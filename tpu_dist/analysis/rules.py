@@ -1,0 +1,192 @@
+"""Rule registry for the distributed-training lint (Layer 1) and jaxpr
+audit (Layer 2).
+
+Each rule ports one correctness/perf discipline that the reference repo
+states only as prose (rank-0 logging, ``no_sync`` accumulation, SyncBN
+placement — SURVEY §2-3) or that the TPU literature identifies as a silent
+killer (sharding-annotation and host-sync mistakes: Xu et al.
+arXiv:2004.13336, Kumar et al. arXiv:2011.03641). The linter walks the
+package with ``ast``; the audit traces registered step builders and
+inspects the closed jaxpr. Both report :class:`Violation` records keyed by
+these IDs.
+
+Suppression: append ``# tpu-dist: ignore[TDxxx]`` (with a reason) to the
+flagged line — or the line directly above — or record the finding in the
+checked-in baseline (see ``tpu_dist/analysis/baseline.py``). Every rule is
+documented in ``docs/analysis.md``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+# --------------------------------------------------------------------------
+# Rule table. TD0xx = AST lint (Layer 1); TD1xx = jaxpr audit (Layer 2).
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    summary: str
+
+
+RULES: dict[str, Rule] = {
+    r.id: r
+    for r in [
+        Rule(
+            "TD001",
+            "host-sync-in-traced-fn",
+            "host-synchronizing call (.item()/float()/np.asarray/"
+            "jax.device_get/.block_until_ready()) inside a traced/jitted "
+            "function — forces a device round-trip every step",
+        ),
+        Rule(
+            "TD002",
+            "unguarded-nonrank0-io",
+            "print/log/file-write not guarded by process_index() == 0 — "
+            "every host duplicates the I/O (reference rank-0 discipline, "
+            "tutorials/2 §3)",
+        ),
+        Rule(
+            "TD003",
+            "jit-missing-donate",
+            "jax.jit on a hot-path step/epoch builder without "
+            "donate_argnums — doubles peak HBM by keeping the old "
+            "TrainState alive across the update",
+        ),
+        Rule(
+            "TD004",
+            "version-fragile-jax-import",
+            "direct import of a JAX API that moved between releases "
+            "(shard_map/pjit) — must route through tpu_dist.comm.compat",
+        ),
+        Rule(
+            "TD005",
+            "nondeterminism-in-traced-fn",
+            "np.random/time.time()/stdlib random inside a traced function "
+            "— baked in as a trace-time constant, NOT fresh per step",
+        ),
+        Rule(
+            "TD101",
+            "collective-budget-mismatch",
+            "jaxpr collective count differs from the parallelism config's "
+            "budget — an accidental extra (or missing) cross-replica "
+            "reduce in the compiled step",
+        ),
+        Rule(
+            "TD102",
+            "unexpected-transfer-op",
+            "device_put / host transfer op inside the compiled step jaxpr "
+            "— host↔device traffic on the hot path",
+        ),
+        Rule(
+            "TD103",
+            "bf16-promotion-over-budget",
+            "more bf16→f32 convert_element_type ops than the mixed-"
+            "precision path declares — an implicit promotion is silently "
+            "doing f32 math",
+        ),
+    ]
+}
+
+
+@dataclasses.dataclass
+class Violation:
+    rule: str
+    path: str  # repo-relative file, or "<jaxpr:case>" for Layer 2
+    line: int
+    message: str
+    col: int = 0
+    snippet: str = ""
+
+    def format_text(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        return f"{loc}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def baseline_key(self) -> tuple:
+        """Line numbers drift; baseline entries match on the line's text."""
+        return (self.rule, self.path, self.snippet.strip())
+
+
+# --------------------------------------------------------------------------
+# Lint configuration (Layer 1 knobs, one place).
+# --------------------------------------------------------------------------
+
+# Entry points whose function arguments run under trace (TD001/TD005 scope).
+TRACE_ENTRY_CALLS = {
+    "jax.jit",
+    "jax.pmap",
+    "jax.vmap",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.lax.scan",
+    "jax.lax.map",
+    "jax.lax.cond",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.associative_scan",
+    "jax.experimental.shard_map.shard_map",
+    "jax.shard_map",
+    "tpu_dist.comm.compat.shard_map",
+}
+
+# Fully-resolved call targets that force a host sync (TD001).
+HOST_SYNC_CALLS = {
+    "jax.device_get",
+    "jax.block_until_ready",
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.asanyarray",
+    "numpy.ascontiguousarray",
+}
+# Method names that force a host sync on any receiver (TD001).
+HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# Builtins that force a sync when applied to a traced value (TD001).
+HOST_SYNC_BUILTINS = {"float", "int", "bool"}
+
+# Nondeterministic-at-trace-time call prefixes (TD005).
+NONDETERMINISM_PREFIXES = ("numpy.random.", "random.")
+NONDETERMINISM_CALLS = {
+    "time.time",
+    "time.perf_counter",
+    "time.monotonic",
+    "time.time_ns",
+}
+
+# Logger-ish method names for TD002 (receiver name must look like a logger).
+LOG_METHODS = {"debug", "info", "warning", "error", "critical", "exception", "log"}
+LOGGERISH_NAMES = ("log", "logger")
+
+# Rank-0 guard spellings TD002 recognizes in `if` tests.
+RANK_CALL_SUFFIXES = ("process_index", "is_primary", "get_rank")
+RANK_VAR_NAMES = {"rank", "local_rank", "process_id", "proc_id", "process_index", "pid"}
+
+# Modules exempt from TD002: host-side tooling that never runs inside a
+# multi-process training job (the analysis CLI's own report output).
+TD002_EXEMPT_PARTS = ("tpu_dist/analysis/",)
+
+# TD003 scope: jit calls inside these factory-name patterns are "hot path".
+HOT_FACTORY_REGEX = r"^(make|build)_.*(step|epoch|train|update)"
+
+# Version-fragile imports (TD004): module → names that must come from compat.
+FRAGILE_IMPORTS = {
+    "jax": {"shard_map"},
+    "jax.experimental": {"shard_map", "pjit"},
+    "jax.experimental.shard_map": {"*"},
+    "jax.experimental.pjit": {"*"},
+}
+# The one module allowed to perform those imports.
+COMPAT_MODULE_SUFFIX = "tpu_dist/comm/compat.py"
+
+
+def describe(rule_id: str) -> str:
+    r = RULES.get(rule_id)
+    return f"{r.id} ({r.name}): {r.summary}" if r else rule_id

@@ -25,9 +25,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
 from tpu_dist.comm import mesh as mesh_lib
+from tpu_dist.comm.compat import shard_map
 
 
 def reduce_mean(x, axis_name: str = mesh_lib.DATA_AXIS):

@@ -26,5 +26,7 @@ class MetricsHistory:
             return
         rec = {"ts": round(time.time(), 3), "kind": kind}
         rec.update({k: (float(v) if hasattr(v, "item") else v) for k, v in fields.items()})
+        # tpu-dist: ignore[TD002] — self.path is None off rank 0 (guard in
+        # __init__), so this append only ever runs on the primary process
         with open(self.path, "a") as f:
             f.write(json.dumps(rec) + "\n")

@@ -17,6 +17,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import jax
+
 
 @dataclass
 class AverageMeter:
@@ -78,5 +80,10 @@ class ProgressMeter:
         line = "\t".join(
             [self.prefix + self._counter(batch), *map(str, self.meters)]
         )
-        print(line, flush=True)
+        # rank-0 discipline lives HERE, not at call sites: the reference
+        # guards every progress.display() behind `if rank == 0` and our
+        # evaluation loop did not — printing from each host duplicates the
+        # line world_size times (analysis rule TD002 caught it).
+        if jax.process_index() == 0:
+            print(line, flush=True)
         return line

@@ -104,6 +104,8 @@ class SummaryWriter:
             f"{socket.gethostname()}.{os.getpid()}"
         )
         self.path = os.path.join(logdir, name)
+        # tpu-dist: ignore[TD002] — torch convention: the writer is only
+        # constructed on the primary process (trainer guards is_primary())
         self._f = open(self.path, "ab")
         self._record(_version_event(time.time()))
 

@@ -40,10 +40,17 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+from tpu_dist.comm import compat
+
 try:  # pallas TPU backend is optional at import time (CPU test images)
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
     pltpu = None
+
+# renamed TPUCompilerParams -> CompilerParams across JAX releases
+_CompilerParams = pltpu and (
+    getattr(pltpu, "CompilerParams", None) or getattr(pltpu, "TPUCompilerParams")
+)
 
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() NaN-free
 
@@ -133,7 +140,8 @@ def _fwd(q3, k3, v3, causal, block_q, block_k, interpret, out_dtype=None):
     vp = _pad_to(v3, bk, 1)
     n_q = qp.shape[1] // bq
     n_k = kp.shape[1] // bk
-    scale = 1.0 / float(d) ** 0.5
+    # d is a static Python shape int: float() runs at trace time, no sync
+    scale = 1.0 / float(d) ** 0.5  # tpu-dist: ignore[TD001]
 
     odt = out_dtype or q3.dtype
     kern = functools.partial(
@@ -167,7 +175,7 @@ def _fwd(q3, k3, v3, causal, block_q, block_k, interpret, out_dtype=None):
         # only the innermost (k-block) dim carries softmax state between
         # iterations; batch·heads and q-blocks are free for the TPU to
         # parallelize/pipeline (ADVICE r2)
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -307,7 +315,8 @@ def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret,
     s_kv = k3.shape[1]
     bq = min(block_q, -(-s_q // 8) * 8)
     bk = min(block_k, -(-s_kv // 8) * 8)
-    scale = 1.0 / float(d) ** 0.5
+    # d is a static Python shape int: float() runs at trace time, no sync
+    scale = 1.0 / float(d) ** 0.5  # tpu-dist: ignore[TD001]
     dq_dtype = grad_dtype or q3.dtype
     dk_dtype = grad_dtype or k3.dtype
     dv_dtype = grad_dtype or v3.dtype
@@ -358,7 +367,7 @@ def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret,
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -384,7 +393,7 @@ def _bwd_pallas(q3, k3, v3, o3, m, l, do3, causal, block_q, block_k, interpret,
         ],
         out_shape=[jax.ShapeDtypeStruct(qp.shape, dq_dtype)],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -397,7 +406,8 @@ def _bwd_blocked(q3, k3, v3, o3, m, l, do3, causal, block_k):
     recomputing P from the saved (m, l) — never materializes [S, S]."""
     bh, s_q, d = q3.shape
     s_kv = k3.shape[1]
-    scale = 1.0 / float(d) ** 0.5
+    # d is a static Python shape int: float() runs at trace time, no sync
+    scale = 1.0 / float(d) ** 0.5  # tpu-dist: ignore[TD001]
     bk = min(block_k, s_kv)
 
     qf = q3.astype(jnp.float32)
@@ -524,7 +534,7 @@ def _ring_flash(q3, k3, v3, axis_name, causal, block_q, block_k, interpret):
 
 def _ring_flash_fwd_impl(q3, k3, v3, axis_name, causal, block_q, block_k,
                          interpret):
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     bh, s_q, d = q3.shape
     full, diag, masked = _fwd_variants(q3, k3, v3, block_q, block_k, interpret)
@@ -566,7 +576,7 @@ def _ring_flash_fwd(q3, k3, v3, axis_name, causal, block_q, block_k, interpret):
 
 def _ring_flash_bwd(axis_name, causal, block_q, block_k, interpret, res, do3):
     q3, k3, v3, o3, m, l = res
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     # delta is K/V-independent: compute ONCE, not per rotation
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)

@@ -321,7 +321,9 @@ def make_fsdp_eval_step(
             "count": jnp.sum(mask),
         }
 
-    return jax.jit(
+    # eval reads the TrainState without replacing it — donating would free
+    # buffers the training loop still owns
+    return jax.jit(  # tpu-dist: ignore[TD003]
         eval_step,
         in_shardings=(st_sh, batch_sh, batch_sh, batch_sh),
         out_shardings=rep,

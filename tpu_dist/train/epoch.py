@@ -29,10 +29,11 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_dist.comm import mesh as mesh_lib
+from tpu_dist.comm.compat import shard_map
 from tpu_dist.data.transforms import CIFAR100_MEAN, CIFAR100_STD
 from tpu_dist.nn import functional as F
 from tpu_dist.train.state import TrainState

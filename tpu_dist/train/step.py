@@ -36,9 +36,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
 from tpu_dist.comm import mesh as mesh_lib
+from tpu_dist.comm import compat
+from tpu_dist.comm.compat import shard_map
 from tpu_dist.nn import functional as F
 from tpu_dist.train.state import TrainState
 
@@ -357,7 +358,7 @@ def make_train_step(
         contributions (n_ep× scaled) → pmean over data, divide by n_ep;
         replicated leaves are plain per-shard grads → pmean over both axes.
         """
-        n_ep = lax.axis_size(ep_axis)
+        n_ep = compat.axis_size(ep_axis)
 
         def has_ep(spec):
             return any(
@@ -553,4 +554,6 @@ def make_eval_step(
         out_specs=P(),
         check_vma=False,
     )
-    return jax.jit(sharded)
+    # eval reads the TrainState without replacing it — donating would free
+    # buffers the training loop still owns
+    return jax.jit(sharded)  # tpu-dist: ignore[TD003]
