@@ -86,6 +86,30 @@ def _mfu(flops_per_step: float | None, step_seconds: float, n_devices: int) -> f
     return round(flops_per_step / step_seconds / (peak * n_devices), 4)
 
 
+def _wire_audit(fn, *args, trips: int = 1) -> dict | None:
+    """Static wire-byte accounting of a compiled step/epoch's gradient
+    collectives (the jaxpr-level TD104 model from ``tpu_dist.analysis``),
+    normalized to ONE step via ``trips``. An abstract trace — valid on CPU
+    emulation, where the --grad_compression sweep's throughput numbers are
+    not. Returns None (with a stderr note — this is the sweep's headline
+    metric, a silent drop would read as 'audit unavailable') on failure."""
+    import sys
+
+    try:
+        from tpu_dist.analysis.jaxpr_audit import trace_counts
+
+        w = trace_counts(fn, *args)["wire"]
+        return {
+            k: w[k] // trips
+            for k in ("payload_bytes", "quantized_payload_bytes", "sideband_bytes")
+        }
+    except Exception as e:
+        print(f"bench: wire-byte audit failed ({type(e).__name__}: "
+              f"{(str(e).splitlines() or [''])[0][:160]})",
+              file=sys.stderr, flush=True)
+        return None
+
+
 @dataclass(frozen=True)
 class BenchConfig:
     name: str
@@ -145,7 +169,7 @@ CONFIGS = {
 
 
 def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None,
-        profile_dir: str | None = None) -> dict:
+        profile_dir: str | None = None, grad_compression: str = "none") -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -185,8 +209,15 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None,
     state = jax.device_put(
         TrainState.create(params, bn_state, optimizer), mesh_lib.replicated(mesh)
     )
+    if grad_compression == "int8_ef":
+        from tpu_dist.train.step import init_ef_state
+
+        state = state._replace(ef=init_ef_state(params, mesh))
     if cfg.fused_epoch:
-        return _run_fused(cfg, mesh, model, optimizer, state, n_dev, batch)
+        return _run_fused(
+            cfg, mesh, model, optimizer, state, n_dev, batch,
+            grad_compression=grad_compression,
+        )
     step = make_train_step(
         model.apply,
         optimizer,
@@ -194,6 +225,7 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None,
         grad_accum_steps=cfg.grad_accum,
         sync_bn=cfg.sync_bn,
         compute_dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
+        grad_compression=grad_compression,
     )
 
     rng = np.random.default_rng(0)
@@ -203,6 +235,8 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None,
     labels = mesh_lib.shard_batch(
         mesh, rng.integers(0, cfg.num_classes, batch).astype(np.int32)
     )
+
+    wire = _wire_audit(step, state, images, labels, 0.1)
 
     # AOT-compile once: the same executable serves cost analysis (MFU
     # numerator) AND the measured loop — no double compile.
@@ -231,8 +265,9 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None,
         dt = time.perf_counter() - t0
 
     img_per_sec = batch * steps / dt
-    return {
-        "metric": f"{cfg.name}_train_throughput",
+    tag = "" if grad_compression == "none" else f"_{grad_compression}"
+    out = {
+        "metric": f"{cfg.name}{tag}_train_throughput",
         "value": round(img_per_sec, 1),
         "unit": "images/sec",
         "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
@@ -243,9 +278,15 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None,
         "step_ms": round(1000 * dt / steps, 2),
         "mfu": _mfu(flops_per_step, dt / steps, n_dev),
     }
+    if grad_compression != "none":
+        out["grad_compression"] = grad_compression
+    if wire is not None:
+        out["wire_bytes_per_step"] = wire
+    return out
 
 
-def _run_fused(cfg: BenchConfig, mesh, model, optimizer, state, n_dev: int, batch: int) -> dict:
+def _run_fused(cfg: BenchConfig, mesh, model, optimizer, state, n_dev: int,
+               batch: int, grad_compression: str = "none") -> dict:
     """Bench the device-resident fused-epoch path on the real 50k dataset:
     measures true seconds/epoch including shuffle + augmentation (all
     on-device), one jit call per epoch."""
@@ -265,6 +306,13 @@ def _run_fused(cfg: BenchConfig, mesh, model, optimizer, state, n_dev: int, batc
         batch_per_device=batch // n_dev,
         sync_bn=cfg.sync_bn,
         compute_dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
+        grad_compression=grad_compression,
+    )
+    # whole-epoch program: the scan multiplies per-trip collectives, so
+    # normalize the audit back to one step
+    wire = _wire_audit(
+        runner, state, dx, dy, 0.1, 0,
+        trips=max(1, int(dx.shape[0]) // batch),
     )
     # AOT-compile once (cost analysis + the measured loop share it)
     try:
@@ -289,8 +337,9 @@ def _run_fused(cfg: BenchConfig, mesh, model, optimizer, state, n_dev: int, batc
 
     n_images = int(dx.shape[0])
     img_per_sec = n_images / dt
-    return {
-        "metric": f"{cfg.name}_train_throughput",
+    tag = "" if grad_compression == "none" else f"_{grad_compression}"
+    out = {
+        "metric": f"{cfg.name}{tag}_train_throughput",
         "value": round(img_per_sec, 1),
         "unit": "images/sec",
         "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
@@ -300,6 +349,11 @@ def _run_fused(cfg: BenchConfig, mesh, model, optimizer, state, n_dev: int, batc
         "img_per_sec_per_chip": round(img_per_sec / n_dev, 1),
         "mfu": _mfu(flops_per_epoch, dt, n_dev),
     }
+    if grad_compression != "none":
+        out["grad_compression"] = grad_compression
+    if wire is not None:
+        out["wire_bytes_per_step"] = wire
+    return out
 
 
 def run_attn(seq_len: int, steps: int, warmup: int, *, batch: int = 0,
@@ -637,6 +691,15 @@ def main() -> None:
     p.add_argument("--causal", action="store_true",
                    help="causal masking for --attn")
     p.add_argument(
+        "--grad_compression",
+        choices=("none", "bf16", "int8", "int8_ef", "sweep"),
+        default="none",
+        help="gradient wire format for the measured step; 'sweep' runs the "
+             "config once per mode (one JSON line each) reporting "
+             "wire_bytes_per_step from the static jaxpr audit (works on "
+             "CPU emulation) alongside measured throughput",
+    )
+    p.add_argument(
         "--scaling", action="store_true",
         help="run the config on 1,2,4,...,N-device meshes and report "
              "scaling efficiency (BASELINE's 1→8→32 chip metric; limited "
@@ -671,6 +734,7 @@ def main() -> None:
         args.init_timeout,
         default_invocation=(
             args.config == "resnet18_cifar100"
+            and args.grad_compression == "none"
             and not (args.all or args.table or args.scaling or args.pp
                      or args.attn or args.attn_all or args.profile_dir)
         ),
@@ -709,6 +773,15 @@ def main() -> None:
                 f"| {out['vs_baseline']}x |"
             )
         return
+    if args.grad_compression == "sweep":
+        # per-mode wire bytes (static, exact) + throughput, one line each —
+        # the measured counterpart of the TD104 audit ratios
+        for mode in ("none", "bf16", "int8", "int8_ef"):
+            print(json.dumps(run(
+                CONFIGS[args.config], args.steps, args.warmup,
+                grad_compression=mode,
+            )), flush=True)
+        return
     if args.scaling:
         n = len(jax.devices())
         sizes = [s for s in (1, 2, 4, 8, 16, 32) if s <= n]
@@ -735,6 +808,7 @@ def main() -> None:
         print(json.dumps(run(
             CONFIGS[args.config], args.steps, args.warmup,
             profile_dir=args.profile_dir or None,
+            grad_compression=args.grad_compression,
         )))
 
 

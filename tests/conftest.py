@@ -96,6 +96,8 @@ _QUICK = (
     "test_schedules_and_guard.py::test_nan_guard_raises",
     "test_fused_epoch.py::test_fused_epoch_runs_all_steps_and_trains",
     "test_fused_eval.py::test_fused_eval_counts_and_matches_direct_forward",
+    "test_quantized_collectives.py::test_quantize_scale_correctness_and_error_bound",
+    "test_quantized_collectives.py::test_td104_wire_bytes_int8_vs_bf16_vs_none",
 )
 
 

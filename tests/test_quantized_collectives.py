@@ -1,0 +1,335 @@
+"""Int8 gradient wire format (grad_compression='int8'/'int8_ef'):
+quantize/dequantize round-trip, stochastic-rounding unbiasedness,
+step-level closeness to the uncompressed reduce across all three
+consumers (per-step, fused-epoch, ZeRO-1), error-feedback residual
+checkpointing, convergence parity, and the TD104 static wire-byte
+ratios (the acceptance criterion: int8 ≤ 0.5× bf16, ≤ 0.25× f32)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dist.comm import mesh as mesh_lib
+from tpu_dist.comm.quantize import dequantize_int8, padded_len, quantize_int8
+from tpu_dist.train.optim import SGD
+from tpu_dist.train.state import TrainState
+from tpu_dist.train.step import (
+    init_ef_state,
+    init_sharded_opt_state,
+    make_train_step,
+)
+from tests.helpers import TinyConvNet, TinyMLP
+
+
+def _state(model, mesh, seed=0, ef=None):
+    params, bn = model.init(jax.random.PRNGKey(seed))
+    st = TrainState.create(params, bn, SGD())
+    st = jax.device_put(st, mesh_lib.replicated(mesh))
+    if ef is not None:
+        st = st._replace(ef=ef)
+    return st
+
+
+def _batch(mesh, n=64, c=10, hw=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, hw, hw, 3)).astype(np.float32)
+    y = rng.integers(0, c, n).astype(np.int32)
+    return mesh_lib.shard_batch(mesh, x), mesh_lib.shard_batch(mesh, y)
+
+
+def _leaves(tree):
+    return [np.asarray(t) for t in jax.tree_util.tree_leaves(tree)]
+
+
+# -- quantize/dequantize ------------------------------------------------------
+
+
+def test_quantize_scale_correctness_and_error_bound():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(4, 300)).astype(np.float32)) * 3.0
+    q, s = quantize_int8(x, chunk=64)  # ragged tail: 300 = 4*64 + 44
+    assert q.shape == x.shape and q.dtype == jnp.int8
+    assert s.shape == (4, 5)
+    # scale = per-chunk max|x| / 127: the extreme of each chunk maps to ±127
+    blocks = np.pad(np.asarray(x), ((0, 0), (0, 20))).reshape(4, 5, 64)
+    np.testing.assert_allclose(
+        np.asarray(s), np.abs(blocks).max(-1) / 127.0, rtol=1e-6
+    )
+    # deterministic rounding: |error| <= scale/2 per element
+    err = np.abs(np.asarray(dequantize_int8(q, s, chunk=64)) - np.asarray(x))
+    per_elem_scale = np.repeat(np.asarray(s), 64, axis=-1)[:, :300]
+    assert (err <= per_elem_scale / 2 + 1e-7).all()
+    # all-zero chunks survive exactly
+    z = jnp.zeros((128,), jnp.float32)
+    qz, sz = quantize_int8(z)
+    assert np.asarray(dequantize_int8(qz, sz)).max() == 0.0
+
+
+def test_stochastic_rounding_unbiased_under_fixed_keys():
+    # E over keys of dequant(quantize(x, key)) == x: average the estimate
+    # over many fixed keys and watch the error shrink ~1/sqrt(K)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(512,)).astype(np.float32))
+    _, s = quantize_int8(x, chunk=128)
+    acc = np.zeros(512, np.float64)
+    K = 250
+    for i in range(K):
+        q, s_i = quantize_int8(x, chunk=128, key=jax.random.PRNGKey(i))
+        acc += np.asarray(dequantize_int8(q, s_i, chunk=128), np.float64)
+    mean_err = np.abs(acc / K - np.asarray(x))
+    scale = np.repeat(np.asarray(s), 128)[:512]
+    # per-element standard error of the mean is scale/sqrt(12K); allow 6 sigma
+    assert (mean_err <= 6.0 * scale / np.sqrt(12 * K) + 1e-7).all()
+    # and a single stochastic draw stays within one scale step
+    q1, s1 = quantize_int8(x, chunk=128, key=jax.random.PRNGKey(123))
+    err1 = np.abs(np.asarray(dequantize_int8(q1, s1, chunk=128)) - np.asarray(x))
+    assert (err1 <= scale + 1e-7).all()
+
+
+def test_padded_len():
+    assert padded_len(480, 8) == 480
+    assert padded_len(481, 8) == 488
+    assert padded_len(1, 8) == 8
+
+
+# -- the three consumers ------------------------------------------------------
+
+
+def test_int8_step_close_to_uncompressed_and_differs():
+    mesh = mesh_lib.data_parallel_mesh()
+    model = TinyConvNet()
+    opt = SGD()
+    xs, ys = _batch(mesh)
+    s0 = _state(model, mesh)
+    plain = make_train_step(model.apply, opt, mesh, donate=False)
+    comp = make_train_step(
+        model.apply, opt, mesh, donate=False, grad_compression="int8"
+    )
+    s_p, m_p = plain(s0, xs, ys, 0.1)
+    s_c, m_c = comp(s0, xs, ys, 0.1)
+    assert np.isfinite(float(m_c["loss"]))
+    diffs = []
+    for a, b in zip(_leaves(s_p.params), _leaves(s_c.params)):
+        assert a.dtype == b.dtype == np.float32  # update math stays f32
+        np.testing.assert_allclose(b, a, rtol=5e-2, atol=5e-3)
+        diffs.append(float(np.abs(a - b).max()))
+    assert max(diffs) > 0.0, "quantized path produced bit-identical params"
+
+
+def test_int8_ef_residuals_update_and_match_quant_error():
+    mesh = mesh_lib.data_parallel_mesh()
+    model = TinyMLP(in_dim=8 * 8 * 3)
+    opt = SGD()
+    xs, ys = _batch(mesh)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    ef0 = init_ef_state(params, mesh)
+    s0 = _state(model, mesh, ef=ef0)
+    step = make_train_step(
+        model.apply, opt, mesh, donate=False, grad_compression="int8_ef"
+    )
+    s1, _ = step(s0, xs, ys, 0.1)
+    r1 = np.asarray(s1.ef["r1"])
+    r2 = np.asarray(s1.ef["r2"])
+    assert np.abs(r1).max() > 0.0 and np.abs(r2).max() > 0.0
+    # residuals are quantization error: bounded by one chunk scale of the
+    # (1/n-scaled) gradient — far below the gradient magnitude itself
+    assert np.abs(r1).max() < 1e-1
+    # second step consumes them (no blow-up, state keeps training)
+    s2, m2 = step(s1, xs, ys, 0.1)
+    assert np.isfinite(float(m2["loss"]))
+    assert int(s2.step) == 2
+
+
+def test_int8_grad_accum_and_zero1_compose():
+    mesh = mesh_lib.data_parallel_mesh()
+    model = TinyConvNet()
+    opt = SGD()
+    xs, ys = _batch(mesh)
+
+    step_ga = make_train_step(
+        model.apply, opt, mesh, grad_accum_steps=2, grad_compression="int8",
+        donate=False,
+    )
+    _, m = step_ga(_state(model, mesh), xs, ys, 0.1)
+    assert np.isfinite(float(m["loss"]))
+
+    # ZeRO-1: quantized reduce-scatter leg, param all-gather untouched
+    s0 = _state(model, mesh)
+    flat_opt = init_sharded_opt_state(s0.params, mesh)
+    efz = init_ef_state(s0.params, mesh, zero1=True)
+    s0 = s0._replace(opt_state=flat_opt, ef=efz)
+    step_z1 = make_train_step(
+        model.apply, opt, mesh, shard_weight_update=True,
+        grad_compression="int8_ef", donate=False,
+    )
+    plain_z1 = make_train_step(
+        model.apply, opt, mesh, shard_weight_update=True, donate=False,
+    )
+    s_q, m_q = step_z1(s0, xs, ys, 0.1)
+    s_p, _ = plain_z1(s0._replace(ef=()), xs, ys, 0.1)
+    assert np.isfinite(float(m_q["loss"]))
+    assert "r1" in s_q.ef and "r2" not in s_q.ef  # no quantized second leg
+    for a, b in zip(_leaves(s_p.params), _leaves(s_q.params)):
+        np.testing.assert_allclose(b, a, rtol=5e-2, atol=5e-3)
+
+
+def test_int8_refuses_model_parallel_axes():
+    mesh = mesh_lib.data_parallel_mesh()
+    model = TinyMLP(in_dim=8 * 8 * 3)
+    with pytest.raises(ValueError, match="grad_compression"):
+        make_train_step(
+            model.apply, SGD(), mesh, grad_compression="int8",
+            seq_axis="seq",
+        )
+    with pytest.raises(ValueError, match="grad_compression"):
+        make_train_step(model.apply, SGD(), mesh, grad_compression="fp8")
+
+
+# -- error-feedback residual checkpointing -----------------------------------
+
+
+def test_ef_residuals_checkpoint_roundtrip(tmp_path):
+    from tpu_dist import ckpt as ckpt_lib
+
+    mesh = mesh_lib.data_parallel_mesh()
+    model = TinyMLP(in_dim=8 * 8 * 3)
+    opt = SGD()
+    xs, ys = _batch(mesh)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    s0 = _state(model, mesh, ef=init_ef_state(params, mesh))
+    step = make_train_step(
+        model.apply, opt, mesh, donate=False, grad_compression="int8_ef"
+    )
+    s1, _ = step(s0, xs, ys, 0.1)
+
+    path = ckpt_lib.save(str(tmp_path), s1, epoch=0)
+    restored = ckpt_lib.restore(path, s1)
+    np.testing.assert_array_equal(
+        np.asarray(restored.ef["r1"]), np.asarray(s1.ef["r1"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(restored.ef["r2"]), np.asarray(s1.ef["r2"])
+    )
+
+    # enabling int8_ef on a checkpoint written WITHOUT residuals: restore
+    # cold-starts them at zero instead of refusing the checkpoint
+    s_plain = _state(model, mesh)
+    p2 = ckpt_lib.save(str(tmp_path / "old"), s_plain, epoch=0)
+    restored2 = ckpt_lib.restore(p2, s1)
+    assert np.abs(np.asarray(restored2.ef["r1"])).max() == 0.0
+    assert np.abs(np.asarray(restored2.ef["r2"])).max() == 0.0
+
+
+@pytest.mark.slow  # resnet18 epochs on the emulated CPU mesh (~minutes)
+def test_trainer_int8_ef_fit_and_resume(tmp_path):
+    from tpu_dist.config import TrainConfig
+    from tpu_dist.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        dataset="synthetic_learnable", num_classes=4, model="resnet18",
+        batch_size=64, synthetic_n=128, epochs=1, lr=0.05, eval_every=0,
+        save_every=1, ckpt_dir=str(tmp_path), grad_compression="int8_ef",
+        num_workers=1, log_every=10, seed=0,
+    )
+    t = Trainer(cfg)
+    out = t.fit()
+    assert np.isfinite(out["loss"])
+    r1 = np.asarray(jax.device_get(t.state.ef["r1"]))
+    assert np.abs(r1).max() > 0.0
+
+    t2 = Trainer(cfg.replace(resume=True, epochs=2))
+    assert t2.start_epoch == 1
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(t2.state.ef["r1"])), r1
+    )
+
+
+def test_trainer_refuses_int8_with_model_parallelism():
+    from tpu_dist.config import TrainConfig
+    from tpu_dist.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        dataset="synthetic", batch_size=64, num_workers=1,
+        model="vit_tiny", num_classes=100, tp=2, grad_compression="int8",
+    )
+    with pytest.raises(ValueError, match="grad_compression"):
+        Trainer(cfg)
+
+
+# -- convergence parity -------------------------------------------------------
+
+
+def test_int8_ef_convergence_parity_with_uncompressed():
+    """Short training run: int8_ef's final loss lands within tolerance of
+    the uncompressed run's (the EQuARX claim at CIFAR scale — the wire
+    format must not change what is learned)."""
+    mesh = mesh_lib.data_parallel_mesh()
+    model = TinyConvNet()
+    opt = SGD()
+    xs, ys = _batch(mesh)
+
+    def train(mode):
+        params, _ = model.init(jax.random.PRNGKey(0))
+        ef = init_ef_state(params, mesh) if mode == "int8_ef" else None
+        s = _state(model, mesh, ef=ef)
+        step = make_train_step(
+            model.apply, opt, mesh, donate=False, grad_compression=mode
+        )
+        losses = []
+        for _ in range(60):
+            s, m = step(s, xs, ys, 0.1)
+            losses.append(float(m["loss"]))
+        return losses
+
+    base = train("none")
+    quant = train("int8_ef")
+    # both memorize the batch the same way
+    assert base[-1] < base[0] - 0.2
+    assert quant[-1] < quant[0] - 0.2
+    assert abs(quant[-1] - base[-1]) < 0.15, (base[-1], quant[-1])
+
+
+# -- static wire-byte audit (the acceptance criterion) ------------------------
+
+
+def test_td104_wire_bytes_int8_vs_bf16_vs_none():
+    """jaxpr audit confirms the int8 gradient collective payload is ≤0.5×
+    the bf16 wire mode's and ≤0.25× the uncompressed mode's — for BOTH the
+    per-step and the fused-epoch paths — and that the audit's own TD104
+    gate would fire on a violation."""
+    from tpu_dist.analysis.jaxpr_audit import audit_all, wire_ratio_violations
+
+    cases = [
+        "dp_sgd", "dp_wire_bf16", "dp_int8", "dp_int8_ef",
+        "fused_none", "fused_bf16", "fused_int8", "fused_int8_ef",
+        "zero1_sgd", "zero1_int8",
+    ]
+    report, violations = audit_all(names=cases)
+    assert violations == [], [v.message for v in violations]
+
+    pay = {c: report[c]["wire"]["payload_bytes"] for c in cases}
+    # per-step path
+    assert pay["dp_int8"] <= 0.5 * pay["dp_wire_bf16"]
+    assert pay["dp_int8"] <= 0.25 * pay["dp_sgd"]
+    # error feedback must be pure local arithmetic: identical collective
+    # inventory (count AND wire bytes) to plain int8
+    assert report["dp_int8_ef"]["collectives"] == report["dp_int8"]["collectives"]
+    assert report["dp_int8_ef"]["wire"] == report["dp_int8"]["wire"]
+    # fused-epoch path (whole-epoch scan totals; same ratios)
+    assert pay["fused_int8"] <= 0.5 * pay["fused_bf16"]
+    assert pay["fused_int8"] <= 0.25 * pay["fused_none"]
+    assert report["fused_int8_ef"]["wire"] == report["fused_int8"]["wire"]
+    # ZeRO-1: the GRAD leg (the quantized payload) shrinks 4× vs the f32
+    # reduce-scatter; the param all-gather rightly stays full-width
+    q = report["zero1_int8"]["wire"]["quantized_payload_bytes"]
+    rs = report["zero1_sgd"]["wire"]["by_prim"]["reduce_scatter"]
+    assert q <= 0.25 * rs
+    # sideband (scales + scalar metrics) is reported, small, never hidden
+    assert 0 < report["dp_int8"]["wire"]["sideband_bytes"] < 0.25 * pay["dp_int8"]
+
+    # the gate fires when a quantized case regresses past its ratio
+    bad = dict(report)
+    bad["dp_int8"] = {"wire": {"payload_bytes": pay["dp_wire_bf16"]}}
+    vs = wire_ratio_violations(bad)
+    assert any(v.rule == "TD104" for v in vs)

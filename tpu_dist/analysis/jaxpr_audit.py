@@ -18,6 +18,19 @@ compilation), and walks the closed jaxpr:
   cast transpose + the f32 metric readouts). One more means some op is
   silently promoting — f32 math and double the bytes where bf16 was asked
   for (the promotion-creep failure mode of arXiv:2011.03641 §4).
+* **TD104** — static wire-byte accounting of the gradient collectives
+  under the compressed wire formats (``grad_compression``): each
+  collective eqn is costed with a ring model (``psum`` = reduce-scatter +
+  all-gather = 2 payload legs; ``all_to_all``/``reduce_scatter`` = its
+  operand once; ``all_gather`` = its output once) and bucketed into
+  *payload* (the gradient/param data — int8 under the quantized modes)
+  vs *sideband* (quantization scales, scalar metric reduces). The int8
+  modes must keep gradient payload ≤0.5× the bf16 mode's and ≤0.25× the
+  uncompressed mode's — verified per step for the streaming path and per
+  epoch for the fused-``lax.scan`` path. Sideband is reported (never
+  hidden) but not gated: the f32 scales are a factor ``chunk`` (256)
+  smaller than the payload in ELEMENTS — ``chunk/4`` (64×, ~1.6%) in
+  bytes — by construction, independent of the wire format choice.
 
 Counts are per-*equation*: ``lax.pmean`` over a whole grad pytree emits ONE
 multi-operand ``psum`` eqn, so budgets stay stable as models grow leaves.
@@ -122,6 +135,86 @@ def _walk_eqns(jaxpr, mult: int = 1):
             yield from _walk_eqns(sub, sub_mult)
 
 
+# Per-replica wire legs of each collective under the standard ring model:
+# psum (allreduce) = reduce-scatter + all-gather of its operand; the
+# scatter/gather/transpose prims each move their payload once. The common
+# (n-1)/n send fraction cancels in every ratio TD104 checks, so it is left
+# out — these are RELATIVE budgets, not absolute bandwidth estimates.
+_WIRE_LEGS = {
+    "psum": 2,
+    "pmin": 2,
+    "pmax": 2,
+    "reduce_scatter": 1,
+    "psum_scatter": 1,
+    "all_to_all": 1,
+    "ppermute": 1,
+    "all_gather": 1,  # costed on its OUTPUT (operand is the local shard)
+    "pgather": 1,
+}
+# Float collectives at/above this element count are gradient/param payload;
+# below it they are sideband (scalar metric reduces). Only used when the
+# step has no int8 payload to calibrate against.
+_PAYLOAD_MIN_ELEMS = 32
+
+
+def _eqn_wire(eqn) -> tuple[int, int, bool]:
+    """``(elements, bytes_on_wire, is_int)`` for one collective eqn."""
+    import numpy as np
+
+    def total(vars_):
+        elems = byts = 0
+        for v in vars_:
+            aval = getattr(v, "aval", None)
+            shape = getattr(aval, "shape", ())
+            dt = getattr(aval, "dtype", None)
+            n = int(np.prod(shape)) if shape else 1
+            elems += n
+            byts += n * (np.dtype(dt).itemsize if dt is not None else 4)
+        return elems, byts
+
+    in_e, in_b = total(eqn.invars)
+    out_e, out_b = total(eqn.outvars)
+    legs = _WIRE_LEGS.get(eqn.primitive.name, 1)
+    # all_gather/pgather: the wire carries the gathered OUTPUT; everything
+    # else is costed on what the replica feeds in
+    e, b = (out_e, out_b) if eqn.primitive.name in ("all_gather", "pgather") else (in_e, in_b)
+    dt = getattr(getattr(eqn.invars[0], "aval", None), "dtype", None)
+    # quantized payload is specifically the 8-bit wire (int32 scalar
+    # METRIC reduces — correct-count psums — are sideband, not payload)
+    is_quant = dt is not None and np.dtype(dt).itemsize == 1
+    return max(in_e, out_e), legs * b, is_quant
+
+
+def _wire_buckets(records) -> dict:
+    """Bucket ``(prim, elems, bytes, is_quant, mult)`` collective records
+    into payload vs sideband. int8 collectives are always quantized
+    payload; other collectives are payload when within a factor 8 of the
+    LARGEST message in the step (the gradient/param data, whatever its
+    dtype — so the cut is identical across wire modes and a mid-size
+    non-gradient reduce, e.g. SyncBN statistics, lands in the same bucket
+    under every mode), sideband below it (quantization scales — chunking
+    keeps them ≤ payload/16 in elements — and scalar metric reduces)."""
+    max_e = max((e for _, e, _, _, _ in records), default=0)
+    cut = max(max_e / 8.0, float(_PAYLOAD_MIN_ELEMS))
+    payload = quant = side = 0
+    by_prim: Counter = Counter()
+    for prim, elems, byts, is_q, mult in records:
+        by_prim[prim] += byts * mult
+        if is_q:
+            payload += byts * mult
+            quant += byts * mult
+        elif elems >= cut:
+            payload += byts * mult
+        else:
+            side += byts * mult
+    return {
+        "payload_bytes": payload,
+        "quantized_payload_bytes": quant,
+        "sideband_bytes": side,
+        "by_prim": dict(sorted(by_prim.items())),
+    }
+
+
 def trace_counts(fn, *args) -> dict:
     """Abstractly trace ``fn(*args)`` and tally the audited op classes."""
     import jax
@@ -131,10 +224,13 @@ def trace_counts(fn, *args) -> dict:
     collectives: Counter = Counter()
     transfers = 0
     bf16_to_f32 = 0
+    wire_records = []
     for eqn, mult in _walk_eqns(closed.jaxpr):
         name = eqn.primitive.name
         if name in COLLECTIVE_PRIMS:
             collectives[name] += mult
+            elems, byts, is_int = _eqn_wire(eqn)
+            wire_records.append((name, elems, byts, is_int, mult))
         elif name in TRANSFER_PRIMS:
             transfers += mult
         elif name == "convert_element_type":
@@ -147,6 +243,7 @@ def trace_counts(fn, *args) -> dict:
         "collectives": dict(sorted(collectives.items())),
         "transfers": transfers,
         "bf16_to_f32": bf16_to_f32,
+        "wire": _wire_buckets(wire_records),
     }
 
 
@@ -157,9 +254,14 @@ def trace_counts(fn, *args) -> dict:
 
 class _AuditMLP:
     """BN-free two-layer MLP: the smallest model with a multi-leaf param
-    tree (4 leaves) that still exercises the full step machinery."""
+    tree (4 leaves) that still exercises the full step machinery.
 
-    in_dim, width, classes = 12, 16, 10
+    ``classes=16`` keeps the TOTAL param count (480) divisible by every
+    emulated mesh width (1/2/4/8), so the quantized wire formats' flat
+    padding is zero and the TD104 byte ratios are exact (0.5×/0.25×), not
+    0.5×+padding. No budget depends on the head width."""
+
+    in_dim, width, classes = 12, 16, 16
 
     def init(self, key):
         import jax
@@ -188,16 +290,23 @@ def _dp_setup(mesh, **step_kwargs):
 
     from tpu_dist.train.optim import SGD
     from tpu_dist.train.state import TrainState
-    from tpu_dist.train.step import init_sharded_opt_state, make_train_step
+    from tpu_dist.train.step import (
+        init_ef_state,
+        init_sharded_opt_state,
+        make_train_step,
+    )
 
     model = _AuditMLP()
     params, bn = model.init(jax.random.PRNGKey(0))
     opt = SGD(momentum=0.9, weight_decay=1e-4)
-    if step_kwargs.get("shard_weight_update"):
+    zero1 = bool(step_kwargs.get("shard_weight_update"))
+    if zero1:
         opt_state = init_sharded_opt_state(params, mesh)
     else:
         opt_state = opt.init(params)
     state = TrainState(params, bn, opt_state, jnp.zeros((), jnp.int32))
+    if step_kwargs.get("grad_compression") == "int8_ef":
+        state = state._replace(ef=init_ef_state(params, mesh, zero1=zero1))
     step = make_train_step(model.apply, opt, mesh, sync_bn=False, **step_kwargs)
     n = mesh.devices.size
     batch = 8 * n  # 8 per device: divisible by the accum case's K=4
@@ -205,6 +314,36 @@ def _dp_setup(mesh, **step_kwargs):
     labels = jax.ShapeDtypeStruct((batch,), jnp.int32)
     lr = jax.ShapeDtypeStruct((), jnp.float32)
     return step, (state, images, labels, lr)
+
+
+def _fused_setup(mesh, mode: str):
+    """The fused-epoch (``train/epoch.py``) twin of :func:`_dp_setup`:
+    device-resident dataset sized for 2 scan steps per epoch, so the
+    per-trip collective multiplication is exercised."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.train.epoch import make_fused_epoch
+    from tpu_dist.train.optim import SGD
+    from tpu_dist.train.state import TrainState
+    from tpu_dist.train.step import init_ef_state
+
+    model = _AuditMLP()
+    params, bn = model.init(jax.random.PRNGKey(0))
+    opt = SGD(momentum=0.9, weight_decay=1e-4)
+    state = TrainState(params, bn, opt.init(params), jnp.zeros((), jnp.int32))
+    if mode == "int8_ef":
+        state = state._replace(ef=init_ef_state(params, mesh))
+    epoch = make_fused_epoch(
+        model.apply, opt, mesh, batch_per_device=4, sync_bn=False,
+        compute_dtype=jnp.float32, grad_compression=mode,
+    )
+    n = mesh.devices.size
+    images = jax.ShapeDtypeStruct((8 * n, 2, 2, 3), jnp.uint8)  # 2 steps
+    labels = jax.ShapeDtypeStruct((8 * n,), jnp.int32)
+    lr = jax.ShapeDtypeStruct((), jnp.float32)
+    epoch_idx = jax.ShapeDtypeStruct((), jnp.int32)
+    return epoch, (state, images, labels, lr, epoch_idx)
 
 
 # The plain data-parallel step's collective inventory (per compiled step):
@@ -217,6 +356,17 @@ _DP_BUDGET = {"psum": 4}
 # (arXiv:2004.13336): 3 metric psums remain. (lax.psum_scatter lowers to
 # the `reduce_scatter` primitive.)
 _ZERO1_BUDGET = {"psum": 3, "reduce_scatter": 1, "all_gather": 1}
+# The quantized two-stage reduce (EQuARX-style RS+AG, step.py): the grad
+# psum becomes int8 all_to_all (payload + scale sideband) followed by int8
+# all_gather (payload + sideband) — 4 collective eqns replacing 1, moving
+# a quarter of the f32 bytes. 3 metric psums remain. Error feedback is
+# pure local arithmetic: int8_ef's budget is IDENTICAL to int8's.
+_DP_INT8_BUDGET = {"psum": 3, "all_to_all": 2, "all_gather": 2}
+# ZeRO-1 quantized: the reduce-scatter leg is the int8 all_to_all pair;
+# the param all-gather stays in the param dtype (weights, not gradients).
+_ZERO1_INT8_BUDGET = {"psum": 3, "all_to_all": 2, "all_gather": 1}
+# Fused-epoch budgets: per-trip collectives × the 2 scan steps.
+_FUSED_STEPS = 2
 # bf16 compute declares: 4 bf16→f32 converts from the params-cast transpose
 # (one per param leaf, rebuilding f32 grads) + 1 logits→f32 for metrics
 # + 1 loss→f32 for the metric pmean.
@@ -247,10 +397,55 @@ def _case_zero1_sgd(mesh):
     return fn, args, CollectiveBudget(dict(_ZERO1_BUDGET), bf16_to_f32=None)
 
 
+def _case_dp_wire_bf16(mesh):
+    # the bf16 WIRE format (grad_compression='bf16'; compute stays f32) —
+    # the 2-bytes/element reference point of the TD104 wire ratios. NOT
+    # dp_bf16, which is the bf16 COMPUTE policy over an f32 wire.
+    fn, args = _dp_setup(mesh, grad_compression="bf16")
+    return fn, args, CollectiveBudget(dict(_DP_BUDGET), bf16_to_f32=None)
+
+
+def _case_dp_int8(mesh):
+    fn, args = _dp_setup(mesh, grad_compression="int8")
+    return fn, args, CollectiveBudget(dict(_DP_INT8_BUDGET), bf16_to_f32=None)
+
+
+def _case_dp_int8_ef(mesh):
+    fn, args = _dp_setup(mesh, grad_compression="int8_ef")
+    return fn, args, CollectiveBudget(dict(_DP_INT8_BUDGET), bf16_to_f32=None)
+
+
+def _case_zero1_int8(mesh):
+    fn, args = _dp_setup(
+        mesh, shard_weight_update=True, grad_compression="int8"
+    )
+    return fn, args, CollectiveBudget(dict(_ZERO1_INT8_BUDGET), bf16_to_f32=None)
+
+
+def _fused_budget(per_step: dict) -> dict:
+    return {k: v * _FUSED_STEPS for k, v in per_step.items()}
+
+
+def _case_fused(mode: str, budget: dict):
+    def build(mesh):
+        fn, args = _fused_setup(mesh, mode)
+        return fn, args, CollectiveBudget(_fused_budget(budget), bf16_to_f32=None)
+
+    return build
+
+
 register_audit_case("dp_sgd", _case_dp_sgd)
 register_audit_case("dp_sgd_accum4", _case_dp_sgd_accum)
 register_audit_case("dp_bf16", _case_dp_bf16)
 register_audit_case("zero1_sgd", _case_zero1_sgd)
+register_audit_case("dp_wire_bf16", _case_dp_wire_bf16)
+register_audit_case("dp_int8", _case_dp_int8)
+register_audit_case("dp_int8_ef", _case_dp_int8_ef)
+register_audit_case("zero1_int8", _case_zero1_int8)
+register_audit_case("fused_none", _case_fused("none", _DP_BUDGET))
+register_audit_case("fused_bf16", _case_fused("bf16", _DP_BUDGET))
+register_audit_case("fused_int8", _case_fused("int8", _DP_INT8_BUDGET))
+register_audit_case("fused_int8_ef", _case_fused("int8_ef", _DP_INT8_BUDGET))
 
 
 # --------------------------------------------------------------------------
@@ -272,15 +467,58 @@ def audit_case(name: str, mesh=None) -> tuple[dict, list[Violation]]:
     return counts, _compare(name, counts, budget)
 
 
+# TD104: (quantized case, reference case, max payload-byte ratio). Every
+# pair present in a report is checked; equality is allowed (the int8 modes
+# land EXACTLY on 0.5×bf16 / 0.25×f32 when the flat padding is zero).
+_WIRE_RATIO_CHECKS = (
+    ("dp_int8", "dp_wire_bf16", 0.5),
+    ("dp_int8", "dp_sgd", 0.25),
+    ("dp_int8_ef", "dp_wire_bf16", 0.5),
+    ("dp_int8_ef", "dp_sgd", 0.25),
+    ("fused_int8", "fused_bf16", 0.5),
+    ("fused_int8", "fused_none", 0.25),
+    ("fused_int8_ef", "fused_bf16", 0.5),
+    ("fused_int8_ef", "fused_none", 0.25),
+)
+
+
+def wire_ratio_violations(report: dict) -> list[Violation]:
+    """TD104 over a case→counts report: quantized gradient payload must
+    honor the declared fraction of its reference mode's payload."""
+    out: list[Violation] = []
+    for qcase, ref, lim in _WIRE_RATIO_CHECKS:
+        if qcase not in report or ref not in report:
+            continue
+        qb = report[qcase]["wire"]["payload_bytes"]
+        rb = report[ref]["wire"]["payload_bytes"]
+        if rb and qb > lim * rb:
+            out.append(
+                Violation(
+                    "TD104",
+                    f"<jaxpr:{qcase}>",
+                    0,
+                    f"gradient-collective payload is {qb} B/step vs "
+                    f"{ref}'s {rb} B — exceeds the declared {lim}× wire "
+                    "budget of the quantized format (a leg decompressed, "
+                    "or padding/scale data leaked into the payload)",
+                    snippet=f"payload:{qb}>{lim}x{rb}",
+                )
+            )
+    return out
+
+
 def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     """Run every (or the named) registered case. Returns
-    ``(report, violations)`` where report maps case → op counts."""
+    ``(report, violations)`` where report maps case → op counts.
+    Cross-case TD104 wire-ratio checks run over whichever quantized/
+    reference pairs the report contains."""
     report: dict = {}
     violations: list[Violation] = []
     for name in names if names is not None else registered_cases():
         counts, vs = audit_case(name, mesh)
         report[name] = counts
         violations.extend(vs)
+    violations.extend(wire_ratio_violations(report))
     return report, violations
 
 

@@ -89,6 +89,14 @@ RULES: dict[str, Rule] = {
             "precision path declares — an implicit promotion is silently "
             "doing f32 math",
         ),
+        Rule(
+            "TD104",
+            "quantized-wire-bytes-over-budget",
+            "gradient-collective payload bytes of a quantized wire format "
+            "exceed the declared ratio of its reference mode (int8 must "
+            "stay ≤0.5× bf16 / ≤0.25× f32) — a wire leg silently "
+            "decompressed",
+        ),
     ]
 }
 

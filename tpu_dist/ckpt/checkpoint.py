@@ -55,12 +55,29 @@ def _flatten(tree) -> dict:
     return flat
 
 
+_EF_KEY_PREFIX = "['ef']"  # TrainState.ef subtree in keystr form
+
+
+def _missing_ok(key: str, leaf) -> Optional[np.ndarray]:
+    """Zeros for a template leaf the checkpoint may legitimately lack:
+    enabling ``int8_ef`` on a checkpoint written without residuals — zero
+    residuals ARE the correct cold start (error feedback warms up in one
+    step). Returns None for every other key (hard error upstream)."""
+    if key.startswith(_EF_KEY_PREFIX):
+        return np.zeros(np.shape(leaf), getattr(leaf, "dtype", np.float32))
+    return None
+
+
 def _unflatten(template, flat: dict):
     paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
     for path, leaf in paths_leaves:
         key = jax.tree_util.keystr(path)
         if key not in flat:
+            zero = _missing_ok(key, leaf)
+            if zero is not None:
+                leaves.append(zero)
+                continue
             raise KeyError(f"checkpoint missing array for {key}")
         arr = flat[key]
         if tuple(arr.shape) != tuple(leaf.shape):
@@ -535,7 +552,22 @@ def restore_sharded(manifest_path: str, template: TrainState) -> TrainState:
         for path, leaf in paths_leaves:
             key = jax.tree_util.keystr(path)
             if key not in pieces:
-                raise KeyError(f"checkpoint missing array for {key}")
+                zero = _missing_ok(key, leaf)
+                if zero is None:
+                    raise KeyError(f"checkpoint missing array for {key}")
+                if not isinstance(leaf, jax.Array):
+                    out.append(zero if zero.shape else zero[()])
+                    continue
+                parts = [
+                    jax.device_put(np.zeros(np.shape(sh.data), zero.dtype), sh.device)
+                    for sh in leaf.addressable_shards
+                ]
+                out.append(
+                    jax.make_array_from_single_device_arrays(
+                        zero.shape, leaf.sharding, parts
+                    )
+                )
+                continue
             gshape = tuple(shapes[key])
             dtype = np.dtype(
                 leaf.dtype if hasattr(leaf, "dtype") else np.asarray(leaf).dtype

@@ -1,0 +1,96 @@
+"""Int8 wire-format quantization for gradient collectives.
+
+The math layer under the ``grad_compression='int8'/'int8_ef'`` contract in
+``tpu_dist.train.step``: per-chunk scaled symmetric int8 quantization with
+optional stochastic rounding (EQuARX, arXiv:2506.17615 — quantized
+allreduce inside XLA recovers most of the gradient bandwidth at negligible
+quality cost; torch's ``PowerSGD``/``quantization_hooks`` family fills the
+same role as DDP communication hooks).
+
+Layout convention: the collective choreography in ``train/step.py`` works
+on FLAT row-major vectors (``ravel_pytree`` of the grad tree, padded to a
+multiple of the axis size), reshaped to ``(n, m)`` rows — one row per
+destination shard. Quantization here is per-*chunk*: each row is cut into
+``chunk``-element blocks, every block gets its own f32 scale
+(``max|x| / 127``), so one outlier poisons at most ``chunk`` neighbours
+instead of the whole tensor. The scale sideband is one f32 per ``chunk``
+int8 elements — a factor ``chunk`` fewer elements, ``chunk/4`` fewer
+BYTES (~1.6%% overhead at the default 256) — and travels as its own
+(tiny) collective next to the payload.
+
+Stochastic rounding (``key is not None``): ``q = floor(x/s + u)``,
+``u ~ U[0,1)`` — unbiased per element (``E[q·s] = x``), which is what lets
+plain ``int8`` train without error feedback at all: quantization noise
+averages out across replicas and steps instead of accumulating as a bias.
+``int8_ef`` additionally carries the *realized* per-replica error forward
+(see ``train/step.py``), compensating even the variance.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Elements per quantization scale. 256 keeps the f32-scale sideband at
+# 4 B per 256 B of int8 payload (≈1.6%) while still isolating outliers.
+DEFAULT_CHUNK = 256
+
+_QMAX = 127.0  # symmetric int8: [-127, 127] (-128 unused, keeps |q| ≤ 127)
+
+
+def padded_len(length: int, n: int) -> int:
+    """Smallest multiple of ``n`` that is >= ``length`` (flat-vector pad so
+    every replica owns an equal shard). Matches the ZeRO-1 flat layout
+    (``step.py::_sharded_update``: ``chunk * n``)."""
+    return -(-int(length) // int(n)) * int(n)
+
+
+def _chunked(x: jnp.ndarray, chunk: int):
+    """Reshape ``(..., m)`` to ``(..., k, chunk)`` with zero tail-padding;
+    returns ``(blocks, k, m)``. The padding is local arithmetic only — the
+    wire carries the unpadded ``m`` elements (callers slice back)."""
+    m = x.shape[-1]
+    k = -(-m // chunk)
+    pad = k * chunk - m
+    if pad:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    return x.reshape(x.shape[:-1] + (k, chunk)), k, m
+
+
+def quantize_int8(x: jnp.ndarray, chunk: int = DEFAULT_CHUNK, key=None):
+    """Quantize ``(..., m)`` f32 to ``(int8 (..., m), f32 scales (..., k))``.
+
+    ``key=None``: round-to-nearest (deterministic). With a key: stochastic
+    rounding, unbiased per element. All-zero chunks quantize to zeros with
+    scale 0 (dequantize maps them back to exact zeros).
+    """
+    blocks, k, m = _chunked(x.astype(jnp.float32), chunk)
+    scales = jnp.max(jnp.abs(blocks), axis=-1) / _QMAX  # (..., k)
+    inv = jnp.where(scales > 0.0, 1.0 / jnp.where(scales > 0.0, scales, 1.0), 0.0)
+    v = blocks * inv[..., None]  # in [-127, 127]
+    if key is None:
+        q = jnp.round(v)
+    else:
+        # floor(v + u) with u ~ U[0,1): E[q] = v exactly
+        u = jax.random.uniform(key, v.shape, jnp.float32)
+        q = jnp.floor(v + u)
+    q = jnp.clip(q, -_QMAX, _QMAX).astype(jnp.int8)
+    return q.reshape(q.shape[:-2] + (k * chunk,))[..., :m], scales
+
+
+def dequantize_int8(q: jnp.ndarray, scales: jnp.ndarray, chunk: int = DEFAULT_CHUNK):
+    """Inverse of :func:`quantize_int8`: ``(..., m) int8 + (..., k) f32 →
+    (..., m) f32``. Tolerates a ragged tail (``m`` need not divide by
+    ``chunk``)."""
+    m = q.shape[-1]
+    k = scales.shape[-1]
+    per_elem = jnp.repeat(scales, chunk, axis=-1)[..., : k * chunk][..., :m]
+    return q.astype(jnp.float32) * per_elem
+
+
+__all__ = [
+    "DEFAULT_CHUNK",
+    "padded_len",
+    "quantize_int8",
+    "dequantize_int8",
+]

@@ -104,9 +104,14 @@ class TrainConfig:
     flash_attention: bool = False  # Pallas tiled attention (ops/flash_attention.py)
                                    # for transformer models; process-global
     remat: bool = False            # jax.checkpoint the forward (less memory)
-    grad_compression: str = "none" # none | bf16: gradient wire format for the
-                                   # cross-replica reduce (DDP bf16_compress_hook
-                                   # equivalent; halves grad ICI/DCN traffic)
+    grad_compression: str = "none" # none | bf16 | int8 | int8_ef: gradient
+                                   # wire format for the cross-replica reduce
+                                   # (DDP comm-hook equivalent). bf16 halves
+                                   # grad ICI/DCN traffic; int8 quarters it
+                                   # (per-chunk scales, stochastic rounding,
+                                   # two-stage quantized RS+AG); int8_ef adds
+                                   # error-feedback residuals in TrainState
+                                   # (docs/compression.md)
     sharded_ckpt: bool = False     # per-process shard files + rank-0 manifest;
                                    # no gather at save time (FSDP/ZeRO scale)
 
@@ -189,13 +194,22 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "models — O(block^2) memory instead of O(S^2)")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint the forward (less activation memory)")
-    p.add_argument("--grad_compression", choices=("none", "bf16"),
+    p.add_argument("--grad_compression",
+                   choices=("none", "bf16", "int8", "int8_ef"),
                    default=d.grad_compression,
-                   help="gradient wire format for the cross-replica reduce: "
-                        "bf16 halves gradient ICI/DCN traffic (torch DDP "
-                        "bf16_compress_hook equivalent; update math stays "
-                        "f32). Not applied under --fsdp (GSPMD-inserted "
-                        "collectives)")
+                   help="gradient wire format for the cross-replica reduce "
+                        "(torch DDP communication-hook equivalent; update "
+                        "math stays f32): bf16 halves gradient ICI/DCN "
+                        "traffic; int8 quarters it via per-chunk scaled "
+                        "stochastic-rounded quantization on BOTH legs of a "
+                        "two-stage reduce-scatter + all-gather (EQuARX-"
+                        "style); int8_ef adds per-replica error-feedback "
+                        "residuals (carried in the TrainState, "
+                        "checkpointed) so quantization error is "
+                        "compensated, not accumulated. int8 modes apply to "
+                        "the plain DP, fused-epoch, and ZeRO-1 paths; not "
+                        "under --fsdp (GSPMD-inserted collectives) or "
+                        "sp/tp/ep/pp (docs/compression.md)")
     p.add_argument("--no_sync_bn", dest="sync_bn", action="store_false",
                    help="per-replica BatchNorm statistics (SyncBN off)")
     p.add_argument("--no_nan_guard", dest="nan_guard", action="store_false")

@@ -166,11 +166,18 @@ class DataLoader:
                 yield item
         finally:
             stop.set()
-            while t.is_alive():  # drain so no producer put can block forever
+            # Abandonment teardown without busy-spinning: ONE drain makes
+            # room for any put already in flight; the producer's bounded
+            # put (0.1 s timeout + stop check) then either lands it in the
+            # freed slot or notices the event — both exit its loop within
+            # one timeout tick, so a plain join suffices. (A producer that
+            # fills the freed slot re-checks `stop` right after the put and
+            # returns — the queue can never refill faster than it exits.)
+            while True:
                 try:
                     q.get_nowait()
                 except queue.Empty:
-                    pass
-                t.join(timeout=0.05)
+                    break
+            t.join()
             if err:
                 raise err[0]

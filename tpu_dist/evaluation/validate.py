@@ -19,6 +19,8 @@ from __future__ import annotations
 import time
 from typing import Callable, Optional
 
+import jax
+
 from tpu_dist.metrics.meters import AverageMeter, ProgressMeter
 from tpu_dist.metrics.logging import rank0_print
 
@@ -42,7 +44,9 @@ def validate(loader, state, eval_step: Callable, *, log_every: int = 50, epoch: 
     end = time.time()
     for i, (images, labels, mask) in enumerate(loader):
         sums = eval_step(state, images, labels, mask)
-        sums = {k: float(v) for k, v in sums.items()}
+        # ONE device→host transfer per batch (a per-key float() would
+        # issue four blocking round-trips)
+        sums = {k: float(v) for k, v in jax.device_get(sums).items()}
         n = max(sums["count"], 1.0)
         for k in tot:
             tot[k] += sums[k]

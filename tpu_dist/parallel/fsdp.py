@@ -166,6 +166,7 @@ def make_fsdp_train_step(
     grad_clip_norm: float = 0.0,
     moe_aux_coef: float = 0.01,
     remat: bool = False,
+    grad_compression: str = "none",
     model_kwargs: dict | None = None,
 ):
     """Build ``step(state, images, labels, lr) -> (state, metrics)``, the
@@ -178,7 +179,23 @@ def make_fsdp_train_step(
     ``specs`` is the per-leaf param pytree from :func:`fsdp_specs`. The body
     is written entirely in the global view — no ``pmean``/``psum`` anywhere;
     compare it with the ``shard_map`` version to see what GSPMD buys.
+
+    ``grad_compression`` exists only to make the engine's boundary
+    explicit: this engine accepts ``'none'`` and refuses everything else.
+    The bf16/int8 wire formats (``train/step.py``, docs/compression.md)
+    hook the hand-written collectives of the shard_map engines; here the
+    gradient reduce-scatters are *inserted by the GSPMD partitioner* from
+    sharding annotations — there is no per-tensor seam to quantize at
+    short of rewriting the engine as a shard_map program, which is exactly
+    the other engine. (EQuARX does it INSIDE XLA for this reason.)
     """
+    if grad_compression != "none":
+        raise ValueError(
+            f"grad_compression={grad_compression!r} cannot apply under the "
+            "GSPMD/FSDP engine (collectives are partitioner-inserted, not "
+            "hookable) — use the shard_map engines (plain DP / --zero1) "
+            "for compressed gradient wire formats"
+        )
     K = int(grad_accum_steps)
     st_sh = state_shardings(mesh, specs, opt_specs)
     param_sh = st_sh.params
@@ -265,7 +282,9 @@ def make_fsdp_train_step(
             grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
         grads = lax.with_sharding_constraint(grads, param_sh)
         new_params, new_opt = optimizer.update(grads, state.opt_state, state.params, lr)
-        new_state = TrainState(new_params, new_bn, new_opt, state.step + 1)
+        # ef rides through untouched (always () here — no quantized wire
+        # under GSPMD; see the grad_compression refusal above)
+        new_state = TrainState(new_params, new_bn, new_opt, state.step + 1, state.ef)
 
         b = labels.shape[0]
         c1, c5 = F.topk_correct(logits.astype(jnp.float32), labels, (1, 5))

@@ -83,22 +83,31 @@ def make_fused_epoch(
     std: np.ndarray = CIFAR100_STD,
     moe_aux_coef: float = 0.01,
     grad_compression: str = "none",
+    quant_chunk: int | None = None,
     model_kwargs: dict | None = None,
 ):
     """Build ``epoch(state, images_u8, labels, lr, epoch_idx) ->
     (state, metrics)`` running every step of the epoch on device.
 
     ``images_u8``/``labels`` from :func:`put_dataset_on_device`.
-    ``grad_compression``: same contract as ``make_train_step`` (bf16 wire
-    format for the grad pmean — the shared helpers in ``train/step.py``
-    define it once for both paths).
+    ``grad_compression``: same contract as ``make_train_step`` (bf16 cast
+    or int8/int8_ef quantized two-stage wire for the grad reduce — the
+    shared helpers in ``train/step.py`` define it ONCE for both paths).
+    Under ``int8_ef`` the error-feedback residuals ride the ``lax.scan``
+    carry inside ``TrainState.ef`` (build with ``step.init_ef_state``),
+    so every step of the fused epoch compensates the previous step's
+    quantization error exactly like the streaming path.
     """
+    from tpu_dist.comm.quantize import DEFAULT_CHUNK  # noqa: PLC0415
     from tpu_dist.train.step import (  # noqa: PLC0415
+        _QUANT_KEY_SEED,
         compressed_pmean,
+        ef_state_spec,
         validate_grad_compression,
     )
 
     validate_grad_compression(grad_compression)
+    q_chunk = int(quant_chunk) if quant_chunk else DEFAULT_CHUNK
     bn_axis = axis if sync_bn else None
     mean_c = jnp.asarray(mean, jnp.float32)
     std_inv_c = jnp.asarray(1.0 / std, jnp.float32)
@@ -146,7 +155,18 @@ def make_fused_epoch(
             x = augment(imgs, jax.random.fold_in(base, i + 1))
 
             (loss, (new_bn, logits)), grads = grad_fn(state.params, state.bn_state, x, ys)
-            grads = compressed_pmean(grads, axis, grad_compression)
+            # same per-step/per-replica stochastic-rounding stream as the
+            # streaming path (step.py::quant_key); no-op for none/bf16
+            qkey = jax.random.fold_in(
+                jax.random.fold_in(
+                    jax.random.PRNGKey(_QUANT_KEY_SEED), state.step
+                ),
+                dev,
+            )
+            grads, new_ef = compressed_pmean(
+                grads, axis, grad_compression,
+                key=qkey, ef=state.ef, chunk=q_chunk,
+            )
             if not sync_bn:
                 new_bn = lax.pmean(new_bn, axis)
             new_params, new_opt = optimizer.update(grads, state.opt_state, state.params, lr)
@@ -156,16 +176,24 @@ def make_fused_epoch(
                 "acc1": lax.psum(c1, axis) / (batch_per_device * lax.psum(1, axis)) * 100.0,
                 "acc5": lax.psum(c5, axis) / (batch_per_device * lax.psum(1, axis)) * 100.0,
             }
-            return TrainState(new_params, new_bn, new_opt, state.step + 1), metrics
+            return TrainState(
+                new_params, new_bn, new_opt, state.step + 1, new_ef
+            ), metrics
 
         state, ms = lax.scan(body, state, jnp.arange(steps))
         return state, jax.tree_util.tree_map(lambda t: t.mean(), ms)
 
+    # the state is replicated except the (per-replica, data-axis-sharded)
+    # error-feedback residuals of the int8_ef wire format
+    state_spec = TrainState(
+        params=P(), bn_state=P(), opt_state=P(), step=P(),
+        ef=ef_state_spec(grad_compression, axis=axis),
+    )
     sharded = shard_map(
         epoch_local,
         mesh=mesh,
-        in_specs=(P(), P(axis), P(axis), P(), P()),
-        out_specs=(P(), P()),
+        in_specs=(state_spec, P(axis), P(axis), P(), P()),
+        out_specs=(state_spec, P()),
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
@@ -180,6 +208,7 @@ def make_fused_eval(
     axis: str = mesh_lib.DATA_AXIS,
     mean: np.ndarray = CIFAR100_MEAN,
     std: np.ndarray = CIFAR100_STD,
+    ef_specs=(),
     model_kwargs: dict | None = None,
 ):
     """Whole-test-set evaluation as ONE jit call over device-resident data.
@@ -231,10 +260,15 @@ def make_fused_eval(
         sums, _ = lax.scan(body, zero, jnp.arange(steps))
         return jax.tree_util.tree_map(lambda t: lax.psum(t, axis), sums)
 
+    # ``ef_specs``: layout of the int8_ef residuals when the training state
+    # carries them (eval never reads them; the in_specs must still match)
+    state_spec = TrainState(
+        params=P(), bn_state=P(), opt_state=P(), step=P(), ef=ef_specs
+    )
     sharded = shard_map(
         eval_local,
         mesh=mesh,
-        in_specs=(P(), P(axis), P(axis)),
+        in_specs=(state_spec, P(axis), P(axis)),
         out_specs=P(),
         check_vma=False,
     )

@@ -6,6 +6,13 @@ single immutable pytree, replicated over the mesh. This is what the
 checkpoint layer serializes (params + opt state + epoch — the rank-0 save
 pattern of reference ``tutorials/2:§7``, plus BN stats which torch keeps
 inside ``state_dict`` buffers).
+
+``ef`` carries the error-feedback residuals of the quantized gradient
+wire format (``grad_compression='int8_ef'``, train/step.py): flat f32
+vectors laid over the data axis — per-REPLICA state, the one part of the
+TrainState that is deliberately NOT replicated. Empty (``()``, zero
+pytree leaves) for every other compression mode, so existing
+4-argument constructions, checkpoints, and shard specs are unchanged.
 """
 
 from __future__ import annotations
@@ -20,6 +27,7 @@ class TrainState(NamedTuple):
     bn_state: Any    # BatchNorm running mean/var (pytree)
     opt_state: Any   # momentum buffers (pytree, same structure as params)
     step: jnp.ndarray  # global step counter, int32 scalar
+    ef: Any = ()     # error-feedback residuals (int8_ef wire format only)
 
     @classmethod
     def create(cls, params, bn_state, optimizer) -> "TrainState":

@@ -58,9 +58,23 @@ def extract_aux_loss(new_bn):
     return new_bn, None
 
 
+GRAD_COMPRESSION_MODES = ("none", "bf16", "int8", "int8_ef")
+
+# Modes that use the quantized two-stage reduce below. They are scoped to
+# the plain data-parallel reduce (per-step and fused-epoch) and the ZeRO-1
+# reduce-scatter; the model-parallel reduces (tp/ep/pp/sp) keep the cast
+# wire formats — see make_train_step's composition wall.
+QUANTIZED_MODES = ("int8", "int8_ef")
+
+_QUANT_KEY_SEED = 0x1D8  # stochastic-rounding PRNG stream, folded per step
+
+
 def validate_grad_compression(mode: str) -> None:
-    if mode not in ("none", "bf16"):
-        raise ValueError(f"grad_compression must be 'none' or 'bf16', got {mode!r}")
+    if mode not in GRAD_COMPRESSION_MODES:
+        raise ValueError(
+            f"grad_compression must be one of {GRAD_COMPRESSION_MODES}, "
+            f"got {mode!r}"
+        )
 
 
 def grad_wire(g, mode: str):
@@ -68,7 +82,9 @@ def grad_wire(g, mode: str):
     the compression contract, shared by the per-step path here and the
     fused-epoch path (``train/epoch.py``) so the semantics cannot drift.
     ``'bf16'`` halves gradient ICI/DCN traffic (full f32 exponent range,
-    so the pre-reduce 1/n scaling cannot underflow)."""
+    so the pre-reduce 1/n scaling cannot underflow). The int8 modes do not
+    go through this per-leaf cast — they reduce on the flat quantized
+    two-stage path (:func:`quantized_pmean_flat`)."""
     return g.astype(jnp.bfloat16) if mode == "bf16" else g
 
 
@@ -77,13 +93,161 @@ def grad_unwire(g, like, mode: str):
     return g.astype(like.dtype) if mode == "bf16" else g
 
 
-def compressed_pmean(grads, axes, mode: str):
-    """``lax.pmean`` of a grad pytree on the compressed wire format."""
-    if mode == "none":
-        return lax.pmean(grads, axes)
-    return jax.tree_util.tree_map(
-        lambda g: grad_unwire(lax.pmean(grad_wire(g, mode), axes), g, mode), grads
+def ef_state_spec(mode: str, *, zero1: bool = False, axis: str = mesh_lib.DATA_AXIS):
+    """PartitionSpec tree for ``TrainState.ef`` under ``mode``.
+
+    The residuals are flat f32 vectors laid over the data axis (per-replica
+    state — each replica compensates ITS OWN quantization error): ``r1``
+    covers the leg-1 (send-side) error over the full padded gradient,
+    ``r2`` the leg-2 error on the owned reduced shard. ZeRO-1 has no
+    quantized second leg (the param all-gather stays in the param dtype),
+    so only ``r1`` exists there. Every other mode carries ``()``.
+    """
+    if mode != "int8_ef":
+        return ()
+    spec = {"r1": P(axis)}
+    if not zero1:
+        spec["r2"] = P(axis)
+    return spec
+
+
+def ef_state_host_zeros(params, n: int, *, zero1: bool = False):
+    """Host (numpy) zero residuals matching :func:`ef_state_spec`'s layout
+    for an ``n``-way data axis — the placement-free half of
+    :func:`init_ef_state` (the Trainer places these with
+    ``mesh.place_host_tree``, which also covers multi-host meshes)."""
+    import numpy as np  # noqa: PLC0415
+    from jax.flatten_util import ravel_pytree  # noqa: PLC0415
+
+    from tpu_dist.comm.quantize import padded_len  # noqa: PLC0415
+
+    L = ravel_pytree(params)[0].shape[0]
+    P_len = padded_len(L, n)
+    ef = {"r1": np.zeros((n * P_len,), np.float32)}
+    if not zero1:
+        ef["r2"] = np.zeros((P_len,), np.float32)
+    return ef
+
+
+def init_ef_state(
+    params, mesh: Mesh, *, zero1: bool = False, axis: str = mesh_lib.DATA_AXIS,
+):
+    """Zero error-feedback residuals, placed on the mesh (the ``int8_ef``
+    counterpart of :func:`init_sharded_opt_state`): ``r1`` is one padded
+    gradient-length vector PER replica (global ``(n*P,)``, sharded over
+    ``axis``), ``r2`` one reduced-shard vector per replica (global
+    ``(P,)``)."""
+    ef = ef_state_host_zeros(params, int(mesh.shape[axis]), zero1=zero1)
+    return mesh_lib.place_host_tree(
+        mesh, ef, ef_state_spec("int8_ef", zero1=zero1, axis=axis)
     )
+
+
+def _quantized_reduce_scatter_rows(rows, axis: str, key, chunk: int):
+    """EQuARX-style quantized reduce-scatter of ``rows`` ``(n, m)`` over
+    ``axis``: quantize → int8 ``all_to_all`` (+ tiny f32 scale sideband) →
+    local dequantize-sum. Returns ``(reduced_shard (m,), sent)`` where
+    ``sent`` is this replica's dequantized transmission (for the
+    error-feedback residual).
+
+    This is the software spelling of a quantized ``psum_scatter``: the
+    transpose leg carries int8 instead of f32 (4× fewer wire bytes), the
+    reduction itself runs locally in f32 — no int overflow, same
+    schedule-shape as the ring reduce-scatter XLA emits for ``psum``.
+    """
+    from tpu_dist.comm.quantize import dequantize_int8, quantize_int8  # noqa: PLC0415
+
+    q, s = quantize_int8(rows, chunk, key)
+    qt = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
+    st = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=True)
+    reduced = jnp.sum(dequantize_int8(qt, st, chunk), axis=0)
+    return reduced, dequantize_int8(q, s, chunk)
+
+
+def quantized_pmean_flat(grads, axis: str, *, key, ef, chunk: int):
+    """Two-stage quantized mean of a grad pytree over ``axis`` — the int8
+    replacement for ``lax.pmean(grads)`` (EQuARX, arXiv:2506.17615): BOTH
+    wire legs are compressed, not just the input.
+
+    1. Flatten + pad to a multiple of n, pre-scale by 1/n (so the
+       dequantize-sum lands on the MEAN; bf16-wire precedent: the f32
+       exponent range of the scales makes this safe).
+    2. Leg 1: per-chunk int8 quantize, ``all_to_all`` the rows — each
+       replica reduces its own shard locally in f32 (quantized
+       reduce-scatter).
+    3. Leg 2: re-quantize the reduced shard, int8 ``all_gather`` (+ scale
+       sideband), dequantize, unravel.
+
+    ``ef``: ``()`` for plain ``int8`` (stochastic rounding alone keeps the
+    estimate unbiased); the ``{"r1", "r2"}`` residual dict for
+    ``int8_ef`` — the residual is added BEFORE quantization and the
+    realized error carried to the next step (error feedback, per replica,
+    for each leg independently). Returns ``(mean_grads, new_ef)``.
+    """
+    from jax.flatten_util import ravel_pytree  # noqa: PLC0415
+
+    from tpu_dist.comm.quantize import (  # noqa: PLC0415
+        dequantize_int8,
+        padded_len,
+        quantize_int8,
+    )
+
+    n = compat.axis_size(axis)
+    flat, unravel = ravel_pytree(grads)
+    L = flat.shape[0]
+    P_len = padded_len(L, n)
+    m = P_len // n
+    x = jnp.pad(flat, (0, P_len - L)) / n
+    if ef:
+        x = x + ef["r1"]
+    k1 = jax.random.fold_in(key, 1)
+    k2 = jax.random.fold_in(key, 2)
+    reduced, sent = _quantized_reduce_scatter_rows(
+        x.reshape(n, m), axis, k1, chunk
+    )
+    new_ef = ()
+    if ef:
+        new_ef = {"r1": x - sent.reshape(P_len)}
+        reduced = reduced + ef["r2"]
+    q2, s2 = quantize_int8(reduced, chunk, k2)
+    if ef:
+        new_ef["r2"] = reduced - dequantize_int8(q2, s2, chunk)
+    qg = lax.all_gather(q2, axis, tiled=True)
+    sg = lax.all_gather(s2, axis, tiled=True)
+    full = dequantize_int8(
+        qg.reshape(n, m), sg.reshape(n, -1), chunk
+    ).reshape(P_len)[:L]
+    return unravel(full), new_ef
+
+
+def compressed_pmean(grads, axes, mode: str, *, key=None, ef=(), chunk=None):
+    """Cross-replica grad mean on the compressed wire format — the shared
+    entry point of the per-step and fused-epoch paths. Returns
+    ``(mean_grads, new_ef)``; ``new_ef`` is ``()`` except under
+    ``int8_ef`` (pass the state's residuals in as ``ef``). ``key`` seeds
+    the stochastic rounding for the quantized modes (required there)."""
+    if mode in QUANTIZED_MODES:
+        if isinstance(axes, (tuple, list)):
+            raise ValueError(
+                "int8 grad compression reduces over a single mesh axis "
+                f"(got {axes!r}) — see make_train_step's composition wall"
+            )
+        from tpu_dist.comm.quantize import DEFAULT_CHUNK  # noqa: PLC0415
+
+        return quantized_pmean_flat(
+            grads, axes, key=key, ef=ef if mode == "int8_ef" else (),
+            chunk=chunk or DEFAULT_CHUNK,
+        )
+    if mode == "none":
+        return lax.pmean(grads, axes), ef
+    # one multi-operand psum for the whole tree (same eqn shape as the
+    # per-step path, so the TD101 budgets match across both consumers)
+    wired = lax.pmean(
+        jax.tree_util.tree_map(lambda g: grad_wire(g, mode), grads), axes
+    )
+    return jax.tree_util.tree_map(
+        lambda g, like: grad_unwire(g, like, mode), wired, grads
+    ), ef
 
 
 def make_train_step(
@@ -107,6 +271,7 @@ def make_train_step(
     param_specs=None,
     remat: bool = False,
     grad_compression: str = "none",
+    quant_chunk: int | None = None,
     model_kwargs: dict | None = None,
 ):
     """Build ``step(state, images, labels, lr) -> (state, metrics)``.
@@ -143,16 +308,53 @@ def make_train_step(
     stays f32; only the wire format changes. Applies to the DP/EP/SP
     reduces and the ZeRO-1 reduce-scatter; the FSDP engine's collectives
     are GSPMD-inserted and are not hooked.
+
+    ``grad_compression='int8'`` / ``'int8_ef'``: per-chunk scaled int8
+    with stochastic rounding, reduced as a two-stage quantized
+    reduce-scatter + all-gather (EQuARX-style — BOTH wire legs are int8,
+    ~4× less gradient traffic than f32, 2× less than bf16; see
+    docs/compression.md). ``int8_ef`` adds per-replica error-feedback
+    residuals carried in ``TrainState.ef`` (build with
+    :func:`init_ef_state`), so the realized quantization error is
+    compensated on the next step rather than discarded. Scoped to the
+    plain data-parallel reduce and the ZeRO-1 reduce-scatter (the ZeRO-1
+    param all-gather stays in the param dtype — it carries weights, not
+    gradients); the model-parallel reduces (tp/ep/pp/sp) are refused, and
+    the FSDP engine's GSPMD collectives remain unhookable.
     """
     K = int(grad_accum_steps)
     n_axis = int(mesh.shape[axis])
     validate_grad_compression(grad_compression)
+    quantized = grad_compression in QUANTIZED_MODES
+    from tpu_dist.comm.quantize import DEFAULT_CHUNK  # noqa: PLC0415
+
+    q_chunk = int(quant_chunk) if quant_chunk else DEFAULT_CHUNK
+    if quantized and any(
+        a is not None for a in (tp_axis, ep_axis, pp_axis, seq_axis)
+    ):
+        # the flat two-stage reduce assumes a replicated param tree and one
+        # reduce axis; the model-parallel engines reduce per leaf over
+        # other axes with their own layouts — cast compression (bf16)
+        # composes there, the quantized transpose does not
+        raise ValueError(
+            f"grad_compression={grad_compression!r} is scoped to the plain "
+            "data-parallel and ZeRO-1 paths; it cannot combine with "
+            "sp/tp/ep/pp (use grad_compression='bf16' there)"
+        )
 
     def wire(g):
         return grad_wire(g, grad_compression)
 
     def unwire(g, like):
         return grad_unwire(g, like, grad_compression)
+
+    def quant_key(step):
+        """Per-step, per-replica stochastic-rounding stream (deterministic
+        replay: folds the step counter, then this replica's position)."""
+        return jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(_QUANT_KEY_SEED), step),
+            lax.axis_index(axis),
+        )
     # Composition walls. grad_clip_norm composes with EVERY axis (the clip
     # computes a shard-aware global norm — see clip_grads). The remaining
     # exclusions are genuinely structural, not deferred work:
@@ -316,11 +518,21 @@ def make_train_step(
             # per-rank stats and saves rank 0's — documented deviation).
             new_bn = lax.pmean(new_bn, axis)
 
+        new_ef = state.ef
         if shard_weight_update:
-            new_params, new_opt = _sharded_update(state, grads, lr)
+            new_params, new_opt, new_ef = _sharded_update(state, grads, lr)
         else:
             if ep_axis is not None:
                 grads = _ep_grad_reduce(grads)
+            elif quantized:
+                # THE data-parallel reduce on the int8 wire: two-stage
+                # quantized reduce-scatter + all-gather, residuals carried
+                # in the state under int8_ef
+                grads, new_ef = quantized_pmean_flat(
+                    grads, axis, key=quant_key(state.step),
+                    ef=state.ef if grad_compression == "int8_ef" else (),
+                    chunk=q_chunk,
+                )
             else:
                 # THE data-parallel step: average grads over the mesh (DDP),
                 # on the (optionally bf16-compressed) wire format; one cast
@@ -338,7 +550,7 @@ def make_train_step(
             new_params, new_opt = optimizer.update(
                 grads, state.opt_state, state.params, lr
             )
-        new_state = TrainState(new_params, new_bn, new_opt, state.step + 1)
+        new_state = TrainState(new_params, new_bn, new_opt, state.step + 1, new_ef)
 
         # Replica-averaged metrics, fused into the same program
         labels_all = labels
@@ -380,7 +592,14 @@ def make_train_step(
         any optimizer whose update is elementwise over its buffers: SGD's
         momentum rides as one flat vector, AdamW's mu/nu as two (with the
         ``auto`` decay mask converted to a positional per-element vector —
-        leaf ranks are invisible in the flat layout)."""
+        leaf ranks are invisible in the flat layout).
+
+        Under the int8 modes the reduce-scatter leg carries the quantized
+        wire (the one gradient collective in this engine); the param
+        all-gather below stays in the param dtype — it moves weights, and
+        quantizing weights would drift the replicated copies, a different
+        trade than compressing a gradient that feeds a smooth update.
+        Returns ``(params, opt_state, ef)``."""
         from jax.flatten_util import ravel_pytree  # noqa: PLC0415
 
         if seq_axis is not None:
@@ -394,10 +613,22 @@ def make_train_step(
         L = flat_g.shape[0]
         chunk = -(-L // n_axis)
         pad = chunk * n_axis - L
-        g_shard = lax.psum_scatter(
-            wire(jnp.pad(flat_g / n_axis, (0, pad))), axis,
-            scatter_dimension=0, tiled=True,
-        ).astype(flat_g.dtype)
+        new_ef = state.ef
+        if quantized:
+            x = jnp.pad(flat_g / n_axis, (0, pad))
+            if grad_compression == "int8_ef":
+                x = x + state.ef["r1"]
+            g_shard, sent = _quantized_reduce_scatter_rows(
+                x.reshape(n_axis, chunk), axis,
+                quant_key(state.step), q_chunk,
+            )
+            if grad_compression == "int8_ef":
+                new_ef = {"r1": x - sent.reshape(chunk * n_axis)}
+        else:
+            g_shard = lax.psum_scatter(
+                wire(jnp.pad(flat_g / n_axis, (0, pad))), axis,
+                scatter_dimension=0, tiled=True,
+            ).astype(flat_g.dtype)
         if grad_clip_norm > 0.0:  # global norm from shard norms (one psum)
             sq = lax.psum(jnp.sum(jnp.square(g_shard)), axis)
             scale = jnp.minimum(1.0, grad_clip_norm / jnp.maximum(jnp.sqrt(sq), 1e-12))
@@ -420,7 +651,7 @@ def make_train_step(
             g_shard, state.opt_state, p_shard, lr, **kw
         )
         flat_new = lax.all_gather(new_p_shard, axis, tiled=True)[:L]
-        return unravel(flat_new), new_b_shard
+        return unravel(flat_new), new_b_shard, new_ef
 
     p_spec = param_specs if param_specs is not None else P()
     if shard_weight_update:
@@ -442,6 +673,9 @@ def make_train_step(
         bn_state=P(),
         opt_state=opt_spec,
         step=P(),
+        ef=ef_state_spec(
+            grad_compression, zero1=shard_weight_update, axis=axis
+        ),
     )
     batch_spec = P(batch_axes)
     sharded = shard_map(
@@ -491,6 +725,7 @@ def make_eval_step(
     pp_axis: str | None = None,
     param_specs=None,
     opt_specs=None,
+    ef_specs=(),
     model_kwargs: dict | None = None,
 ):
     """Build ``eval_step(state, images, labels, mask) -> sums``.
@@ -498,6 +733,9 @@ def make_eval_step(
     ``opt_specs``: partition specs for the optimizer state when its TREE
     differs from the param tree (AdamW under TP/EP/PP) — eval never reads
     it, but the shard_map in_specs must still match its structure.
+    ``ef_specs``: same story for the error-feedback residuals of the
+    ``int8_ef`` wire format (:func:`ef_state_spec`) — eval ignores them,
+    the in_specs must still describe their data-axis layout.
 
     Returns GLOBAL sums (loss·mask, top1, top5, count) so the host can
     divide once at the end — unlike the reference's ``validate()``, which
@@ -546,6 +784,7 @@ def make_eval_step(
         bn_state=P(),
         opt_state=opt_specs if opt_specs is not None else p_spec,
         step=P(),
+        ef=ef_specs,
     )
     sharded = shard_map(
         eval_local,

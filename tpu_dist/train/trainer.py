@@ -58,6 +58,14 @@ class TrainingDivergedError(RuntimeError):
     Catch it and restore from ``ckpt_dir`` to implement auto-recovery."""
 
 
+def _fetch_metrics(metrics) -> dict:
+    """ONE device→host transfer for the whole metrics dict. A per-key
+    ``float(v)`` comprehension issues one blocking D2H round-trip per
+    scalar; ``jax.device_get`` fetches the tree in a single call, and the
+    NaN guard / log line / history all reuse the same host copy."""
+    return {k: float(v) for k, v in jax.device_get(metrics).items()}
+
+
 def register_model(name: str, factory) -> None:
     """Extend the model zoo (``factory(num_classes=...) -> model`` with
     ``init``/``apply``). Lets users swap models the way the reference
@@ -281,8 +289,10 @@ class Trainer:
             if cfg.grad_compression != "none":
                 rank0_print(
                     "WARNING: --grad_compression has no effect under --fsdp "
-                    "— the engine's collectives are GSPMD-inserted from "
-                    "sharding specs, not hookable per-tensor"
+                    "— the engine's collectives (including the gradient "
+                    "reduce-scatters the bf16/int8 wire formats would "
+                    "compress) are GSPMD-inserted from sharding specs, not "
+                    "hookable per-tensor (docs/compression.md)"
                 )
             if cfg.flash_attention:
                 raise ValueError(
@@ -310,6 +320,22 @@ class Trainer:
                 )
             if cfg.pp <= 1:  # under PP×TP the pp branch sets combined specs
                 self._param_specs = self.model.tp_param_specs(mesh_lib.MODEL_AXIS)
+        from tpu_dist.train.step import QUANTIZED_MODES  # noqa: PLC0415
+
+        if (
+            cfg.grad_compression in QUANTIZED_MODES
+            and not cfg.fsdp
+            and (cfg.sp > 1 or cfg.tp > 1 or cfg.ep > 1 or cfg.pp > 1)
+        ):
+            # same wall as make_train_step, caught at the config layer: the
+            # quantized two-stage reduce assumes one data axis over a
+            # replicated param tree (docs/compression.md)
+            raise ValueError(
+                f"grad_compression={cfg.grad_compression!r} is scoped to "
+                "the plain data-parallel, fused-epoch, and ZeRO-1 paths — "
+                "it cannot combine with sp/tp/ep/pp (use "
+                "--grad_compression bf16 there)"
+            )
         if cfg.moe_top_k < 1:
             raise ValueError(f"moe_top_k must be >= 1, got {cfg.moe_top_k}")
         if cfg.moe_top_k > 1:
@@ -551,6 +577,15 @@ class Trainer:
             raise ValueError(f"unknown optimizer {cfg.optimizer!r} (sgd | adamw)")
         params, bn_state = self.model.init(jax.random.PRNGKey(seed))
         state = TrainState.create(params, bn_state, self.optimizer)
+        if cfg.grad_compression == "int8_ef" and not cfg.fsdp:
+            # error-feedback residuals are TrainState: zero-initialized
+            # here, quantization error flows into them each step, and they
+            # ride every checkpoint save/restore like the momentum buffers
+            from tpu_dist.train.step import ef_state_host_zeros  # noqa: PLC0415
+
+            state = state._replace(ef=ef_state_host_zeros(
+                params, self.n_data, zero1=cfg.shard_weight_update
+            ))
         self._fsdp_opt_specs = None
         if cfg.fsdp:
             from tpu_dist.parallel.fsdp import (  # noqa: PLC0415
@@ -621,6 +656,8 @@ class Trainer:
                 model_kwargs=self._attn_model_kwargs() or None,
             )
         else:
+            from tpu_dist.train.step import ef_state_spec  # noqa: PLC0415
+
             self.train_step = self._build_train_step(cfg, compute_dtype)
             self.eval_step = make_eval_step(
                 self.model.apply, self.mesh, compute_dtype=compute_dtype,
@@ -634,6 +671,9 @@ class Trainer:
                     self.optimizer.state_specs(self._param_specs)
                     if self._param_specs is not None
                     else None
+                ),
+                ef_specs=ef_state_spec(
+                    cfg.grad_compression, zero1=cfg.shard_weight_update
                 ),
             )
 
@@ -662,10 +702,13 @@ class Trainer:
                 ti = np.concatenate([ti, np.zeros((pad,) + ti.shape[1:], ti.dtype)])
                 tl = np.concatenate([tl, np.full(pad, -1, tl.dtype)])
             self._fused_test_data = put_dataset_on_device(self.mesh, ti, tl)
+            from tpu_dist.train.step import ef_state_spec  # noqa: PLC0415
+
             self._fused_eval = make_fused_eval(
                 self.model.apply, self.mesh,
                 batch_per_device=cfg.batch_size // self.n_devices,
                 compute_dtype=compute_dtype,
+                ef_specs=ef_state_spec(cfg.grad_compression),
                 model_kwargs=self._attn_model_kwargs() or None, **stats,
             )
 
@@ -854,7 +897,25 @@ class Trainer:
 
     def _place_state(self, state: TrainState) -> TrainState:
         """Mesh placement for every supported layout: replicated (default),
-        per-leaf TP shardings, ZeRO-1 flat-sharded optimizer state."""
+        per-leaf TP shardings, ZeRO-1 flat-sharded optimizer state, and the
+        data-axis-sharded int8_ef residuals (placed apart from the
+        replicated bulk — they are per-replica by construction)."""
+        cfg = self.cfg
+        ef = state.ef
+        if ef:
+            from tpu_dist.train.step import ef_state_spec  # noqa: PLC0415
+
+            ef = mesh_lib.place_host_tree(
+                self.mesh, ef,
+                ef_state_spec(
+                    cfg.grad_compression, zero1=cfg.shard_weight_update
+                ),
+            )
+            state = state._replace(ef=())
+            return self._place_state_bulk(state)._replace(ef=ef)
+        return self._place_state_bulk(state)
+
+    def _place_state_bulk(self, state: TrainState) -> TrainState:
         cfg = self.cfg
         rep = mesh_lib.replicated(self.mesh)
         if self._fsdp_specs is not None:  # FSDP: params+momentum data-sharded
@@ -951,11 +1012,16 @@ class Trainer:
             self._progress = (new_state, epoch, step + 1, False)
             self.state = new_state
             images_seen += cfg.batch_size
-            if (
+            want_save = (
                 cfg.mid_epoch_save_every
                 and cfg.ckpt_dir
                 and (step + 1) % cfg.mid_epoch_save_every == 0
-            ):
+            )
+            want_log = step % cfg.log_every == 0
+            # ONE device fetch serves the snapshot's NaN guard AND the log
+            # line — neither issues its own per-key sync
+            m = _fetch_metrics(metrics) if (want_save or want_log) else None
+            if want_save:
                 # periodic EXACT snapshot (kill-9 safety for long epochs):
                 # same stamp as the interrupt path — ckpt_{epoch} carries
                 # the step offset until the clean end-of-epoch save
@@ -963,9 +1029,9 @@ class Trainer:
                 # NaN guard FIRST: every other save path refuses to publish
                 # a poisoned state, and this one must too (the log_every
                 # guard below may not have run since divergence).
-                if cfg.nan_guard and not np.isfinite(float(metrics["loss"])):
+                if cfg.nan_guard and not np.isfinite(m["loss"]):
                     raise TrainingDivergedError(
-                        f"non-finite loss {float(metrics['loss'])} at epoch "
+                        f"non-finite loss {m['loss']} at epoch "
                         f"{epoch} step {step} (lr={lr}) — caught at the "
                         f"mid-epoch snapshot boundary before writing it; "
                         f"restore from ckpt_dir to recover"
@@ -977,8 +1043,7 @@ class Trainer:
                                 "mid_epoch_batch_size": cfg.batch_size,
                                 "mid_epoch_seed": cfg.seed or 0},
                 )
-            if step % cfg.log_every == 0:
-                m = {k: float(v) for k, v in metrics.items()}  # device sync
+            if want_log:
                 if cfg.nan_guard and not np.isfinite(m["loss"]):
                     raise TrainingDivergedError(
                         f"non-finite loss {m['loss']} at epoch {epoch} step {step} "
@@ -993,14 +1058,14 @@ class Trainer:
                 )
         jax.block_until_ready(self.state.params)
         # end-of-epoch guard: catches divergence between logged steps BEFORE
-        # fit() writes a checkpoint of the poisoned state
-        if cfg.nan_guard and metrics:
-            final_loss = float(metrics["loss"])
-            if not np.isfinite(final_loss):
-                raise TrainingDivergedError(
-                    f"non-finite loss {final_loss} at end of epoch {epoch} "
-                    f"(lr={lr}); restore from ckpt_dir to recover"
-                )
+        # fit() writes a checkpoint of the poisoned state. One fetch, reused
+        # for the returned epoch metrics below.
+        out = _fetch_metrics(metrics) if metrics else {}
+        if cfg.nan_guard and out and not np.isfinite(out["loss"]):
+            raise TrainingDivergedError(
+                f"non-finite loss {out['loss']} at end of epoch {epoch} "
+                f"(lr={lr}); restore from ckpt_dir to recover"
+            )
         if cfg.debug_replica_check:
             from tpu_dist.metrics.consistency import check_replicated  # noqa: PLC0415
 
@@ -1012,7 +1077,6 @@ class Trainer:
         rank0_print(
             f"Epoch {epoch} done in {dt:.2f}s ({ips:.0f} img/s, avg loss {losses.avg:.4f})"
         )
-        out = {k: float(v) for k, v in metrics.items()} if metrics else {}
         out.update(epoch_time=dt, images_per_sec=ips)
         return out
 
@@ -1027,7 +1091,7 @@ class Trainer:
         self.state, metrics = self._fused_runner(
             self.state, *self._fused_data, lr, epoch
         )
-        m = {k: float(v) for k, v in metrics.items()}  # blocks on completion
+        m = _fetch_metrics(metrics)  # one transfer; blocks on completion
         if cfg.nan_guard and not np.isfinite(m["loss"]):
             raise TrainingDivergedError(
                 f"non-finite loss {m['loss']} in fused epoch {epoch} (lr={lr}); "
@@ -1345,10 +1409,9 @@ class Trainer:
                 self._tb.add_scalar("train/lr", self._lr(epoch), epoch)
             if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
                 if self._fused_runner is not None:
-                    sums = {
-                        k: float(v)
-                        for k, v in self._fused_eval(self.state, *self._fused_test_data).items()
-                    }
+                    sums = _fetch_metrics(
+                        self._fused_eval(self.state, *self._fused_test_data)
+                    )
                     n = max(sums["count"], 1.0)
                     t1 = sums["top1"] / n * 100.0
                     t5 = sums["top5"] / n * 100.0
