@@ -215,6 +215,85 @@ def test_td005_jax_random_and_host_np_random_ok():
     assert vs == []
 
 
+# -- TD006: silently swallowed exceptions -----------------------------------
+
+
+def test_td006_silent_pass_and_bare_except_flagged():
+    vs = _lint(
+        """
+        def prune(path):
+            try:
+                remove(path)
+            except OSError:
+                pass
+
+        def anything(x):
+            try:
+                return x()
+            except:
+                return None
+        """
+    )
+    assert _rules(vs) == ["TD006", "TD006"]
+    assert "OSError" in vs[0].message
+    assert "bare" in vs[1].message
+
+
+def test_td006_allowlisted_types_and_handled_bodies_pass():
+    vs = _lint(
+        """
+        import queue
+
+        def probe():
+            try:
+                import optional_dep
+            except ImportError:
+                pass
+            try:
+                cleanup()
+            except FileNotFoundError:
+                pass
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
+
+        def handled():
+            try:
+                risky()
+            except OSError as e:
+                raise RuntimeError("risky failed") from e
+        """
+    )
+    assert vs == []
+
+
+def test_td006_tuple_needs_every_type_allowlisted():
+    vs = _lint(
+        """
+        def mixed():
+            try:
+                go()
+            except (FileNotFoundError, OSError):
+                pass
+        """
+    )
+    assert _rules(vs) == ["TD006"]
+
+
+def test_td006_inline_suppression():
+    vs = _lint(
+        """
+        def prune(path):
+            try:
+                remove(path)
+            except OSError:  # tpu-dist: ignore[TD006] — best-effort prune
+                pass
+        """
+    )
+    assert vs == []
+
+
 # -- suppressions & baseline ------------------------------------------------
 
 

@@ -507,11 +507,56 @@ def wire_ratio_violations(report: dict) -> list[Violation]:
     return out
 
 
+def fault_noop_violations(mesh=None) -> list[Violation]:
+    """TD105: the resilience subsystem's zero-cost contract, checked at the
+    program level — trace the data-parallel step with fault injection OFF
+    and again with a fully-armed composite ``--fault_plan``, and require
+    the two jaxprs to be byte-identical. Every injection point is host-side
+    (checkpoint writer, loader producer, trainer step grain); the moment
+    someone leaks one into the traced step, this trips."""
+    import jax
+
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.resilience import faults
+
+    m = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+    prev = faults.active()
+    faults.clear()
+    try:
+        fn, args = _dp_setup(m)
+        base = str(jax.make_jaxpr(fn)(*args))
+        faults.install(
+            "ckpt_write@call=1:times=2;ckpt_corrupt@epoch=0:mode=bitflip;"
+            "nan_loss@step=0;sigterm@step=999999;loader_stall@batch=0"
+        )
+        fn2, args2 = _dp_setup(m)
+        armed = str(jax.make_jaxpr(fn2)(*args2))
+    finally:
+        faults.clear()
+        if prev is not None:
+            faults.install(prev)
+    if base != armed:
+        return [
+            Violation(
+                "TD105",
+                "<jaxpr:dp_faults_noop>",
+                0,
+                "the traced train step CHANGED when a fault plan was armed "
+                "— a fault-injection point leaked into the compiled "
+                "program; injection must stay host-side "
+                "(resilience/faults.py contract)",
+                snippet="jaxpr(faults_off) != jaxpr(faults_armed)",
+            )
+        ]
+    return []
+
+
 def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     """Run every (or the named) registered case. Returns
     ``(report, violations)`` where report maps case → op counts.
     Cross-case TD104 wire-ratio checks run over whichever quantized/
-    reference pairs the report contains."""
+    reference pairs the report contains; full (unfiltered) runs also check
+    the TD105 fault-injection no-op invariant."""
     report: dict = {}
     violations: list[Violation] = []
     for name in names if names is not None else registered_cases():
@@ -519,6 +564,10 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
         report[name] = counts
         violations.extend(vs)
     violations.extend(wire_ratio_violations(report))
+    if names is None:
+        vs = fault_noop_violations(mesh)
+        report["dp_faults_noop"] = {"identical": not vs}
+        violations.extend(vs)
     return report, violations
 
 

@@ -40,6 +40,7 @@ from tpu_dist.analysis.rules import (
     RANK_CALL_SUFFIXES,
     RANK_VAR_NAMES,
     TD002_EXEMPT_PARTS,
+    TD006_ALLOWED_SILENT,
     TRACE_ENTRY_CALLS,
     Violation,
 )
@@ -356,6 +357,7 @@ class _FileLint:
             self._check_traced_body(fn, emit)
         self._check_io(emit)
         self._check_jit_donate(emit)
+        self._check_silent_except(emit)
         return out
 
     def _check_imports(self, emit) -> None:  # TD004
@@ -472,6 +474,54 @@ class _FileLint:
                 if any(t in basename.lower() for t in LOGGERISH_NAMES):
                     return f"{basename}.{func.attr}()"
         return None
+
+    def _exc_type_names(self, t: ast.AST) -> list[str]:
+        """Dotted names of the handled exception type(s); '<dynamic>' for
+        anything unresolvable (a computed type never passes the allowlist)."""
+        if isinstance(t, ast.Tuple):
+            out: list[str] = []
+            for e in t.elts:
+                out.extend(self._exc_type_names(e))
+            return out
+        resolved = self.resolve(t)
+        return [resolved] if resolved else ["<dynamic>"]
+
+    def _check_silent_except(self, emit) -> None:  # TD006
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                emit(
+                    "TD006",
+                    node,
+                    "bare `except:` also catches SystemExit/"
+                    "KeyboardInterrupt and hides the real failure; catch a "
+                    "concrete exception type",
+                )
+                continue
+            # "silent" = the body does literally nothing: pass / `...`
+            silent = all(
+                isinstance(s, ast.Pass)
+                or (
+                    isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Constant)
+                )
+                for s in node.body
+            )
+            if not silent:
+                continue
+            names = self._exc_type_names(node.type)
+            if all(n.split(".")[-1] in TD006_ALLOWED_SILENT for n in names):
+                continue
+            emit(
+                "TD006",
+                node,
+                f"`except {', '.join(names)}: pass` silently swallows the "
+                "failure — on a multi-process job the first fault then "
+                "surfaces as a collective deadlock; log it, re-raise, or "
+                "narrow to an allowlisted benign type "
+                f"({', '.join(sorted(TD006_ALLOWED_SILENT))})",
+            )
 
     def _check_jit_donate(self, emit) -> None:  # TD003
         for node in ast.walk(self.tree):

@@ -70,6 +70,14 @@ RULES: dict[str, Rule] = {
             "— baked in as a trace-time constant, NOT fresh per step",
         ),
         Rule(
+            "TD006",
+            "silent-exception-swallow",
+            "`except ...: pass` (outside the benign allowlist) or bare "
+            "`except:` silently swallows failures — in a multi-process job "
+            "this hides the first fault until a collective deadlocks; "
+            "re-raise, log, or narrow the type",
+        ),
+        Rule(
             "TD101",
             "collective-budget-mismatch",
             "jaxpr collective count differs from the parallelism config's "
@@ -88,6 +96,14 @@ RULES: dict[str, Rule] = {
             "more bf16→f32 convert_element_type ops than the mixed-"
             "precision path declares — an implicit promotion is silently "
             "doing f32 math",
+        ),
+        Rule(
+            "TD105",
+            "fault-injection-not-noop",
+            "the traced train step differs between fault injection OFF and "
+            "an armed --fault_plan — injection points must be host-side "
+            "no-ops that never enter the compiled program "
+            "(resilience/faults.py contract)",
         ),
         Rule(
             "TD104",
@@ -183,6 +199,21 @@ TD002_EXEMPT_PARTS = ("tpu_dist/analysis/",)
 
 # TD003 scope: jit calls inside these factory-name patterns are "hot path".
 HOT_FACTORY_REGEX = r"^(make|build)_.*(step|epoch|train|update)"
+
+# TD006: exception types a `pass`-only handler may swallow without comment —
+# probe/cleanup idioms where absence IS the answer. Matched on the LAST
+# dotted segment (so `queue.Empty` and a bare `Empty` both pass). Anything
+# else (OSError and friends above all) needs a logged handler or an inline
+# `# tpu-dist: ignore[TD006]` with the audit reason.
+TD006_ALLOWED_SILENT = {
+    "FileNotFoundError",
+    "ImportError",
+    "ModuleNotFoundError",
+    "StopIteration",
+    "Empty",           # queue.Empty poll loops
+    "TimeoutExpired",  # subprocess poll-wait loops
+    "TimeoutError",
+}
 
 # Version-fragile imports (TD004): module → names that must come from compat.
 FRAGILE_IMPORTS = {

@@ -16,14 +16,55 @@ from __future__ import annotations
 import json
 import os
 import re
-from typing import Any, Optional, Tuple
+import zipfile
+import zlib
+from typing import Any, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from tpu_dist.resilience import faults
+from tpu_dist.resilience import retry as retry_lib
 from tpu_dist.train.state import TrainState
 
 _CKPT_RE = re.compile(r"ckpt_(\d+)\.npz$")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file failed integrity verification (torn write, CRC
+    mismatch, unreadable archive). The restore ladder quarantines the file
+    and falls back to the next older checkpoint (docs/resilience.md)."""
+
+
+#: Exceptions a *read* of a damaged checkpoint can raise below the
+#: integrity layer — the restore ladder treats these like a CRC failure.
+#: (Deliberately excludes ValueError: shape/layout mismatches are config
+#: errors that must raise, not quarantine.)
+CKPT_READ_ERRORS = (
+    OSError,
+    EOFError,
+    zlib.error,
+    zipfile.BadZipFile,
+    json.JSONDecodeError,
+)
+
+# Transient-write retry count for every checkpoint file write in this
+# module (process-global, like the compile-cache jax.config state — the
+# Trainer sets it from --ckpt_io_retries). Delays are deterministic
+# exponential backoff (resilience/retry.py).
+_IO_RETRIES = 0
+
+
+def set_io_retries(n: int) -> int:
+    """Set the module-wide transient-write retry count; returns the
+    previous value."""
+    global _IO_RETRIES
+    prev, _IO_RETRIES = _IO_RETRIES, max(0, int(n))
+    return prev
+
+
+def _entry_crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
 
 
 def _scalar_to_host(x):
@@ -91,18 +132,31 @@ def _write_npz(
     keep_last: Optional[int] = None,
 ) -> str:
     """Serialize + atomically publish one checkpoint file (host-side only —
-    safe to run on a worker thread; ``flat`` holds host numpy copies)."""
+    safe to run on a worker thread; ``flat`` holds host numpy copies).
+
+    Per-entry CRC32s are stamped into ``__meta__`` so restore can verify
+    integrity; transient write failures retry per :func:`set_io_retries`
+    (atomic tmp+rename makes an attempt idempotent)."""
     os.makedirs(ckpt_dir, exist_ok=True)
     flat = dict(flat)
+    meta = dict(meta)
+    meta["crc32"] = {k: _entry_crc(v) for k, v in flat.items()}
     flat["__meta__"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
     path = os.path.join(ckpt_dir, name)
     tmp = path + ".tmp"
-    # tpu-dist: ignore[TD002] — every caller holds the rank-0 guard (the
-    # guard can't live here: callers flatten collectively before it)
-    with open(tmp, "wb") as f:
-        np.savez(f, **flat)
-    os.replace(tmp, path)  # atomic: a ckpt file is either absent or complete
+
+    def attempt() -> None:
+        faults.on_ckpt_write()  # no-op unless a --fault_plan clause is armed
+        # tpu-dist: ignore[TD002] — every caller holds the rank-0 guard (the
+        # guard can't live here: callers flatten collectively before it)
+        with open(tmp, "wb") as f:
+            np.savez(f, **flat)
+        os.replace(tmp, path)  # atomic: a ckpt is either absent or complete
+
+    retry_lib.retry_call(attempt, retries=_IO_RETRIES, describe=f"write of {name}")
+    faults.on_ckpt_published(path)  # --fault_plan ckpt_corrupt hook (no-op off)
     if keep_last is not None and keep_last > 0:
+        sweep_stale_tmp(ckpt_dir)  # crash-leaked *.tmp never accumulates
         epochs = sorted(
             int(m.group(1))
             for m in (_CKPT_RE.search(n) for n in os.listdir(ckpt_dir))
@@ -111,9 +165,30 @@ def _write_npz(
         for e in epochs[:-keep_last]:
             try:
                 os.remove(os.path.join(ckpt_dir, f"ckpt_{e}.npz"))
-            except OSError:
-                pass
+            except OSError:  # tpu-dist: ignore[TD006] — prune is best-effort:
+                pass  # a file already gone (or unlinkable) must not fail a save
     return path
+
+
+def sweep_stale_tmp(ckpt_dir: str) -> List[str]:
+    """Remove checkpoint temp files leaked by a crash between ``open(tmp)``
+    and ``os.replace`` (``*.npz.tmp`` / ``*.manifest.json.tmp``). Safe only
+    under the single-writer discipline: call from the writing process with
+    no write in flight (the prune path and resume startup both qualify).
+    Returns the removed names."""
+    removed: List[str] = []
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return removed
+    for n in names:
+        if n.endswith(".npz.tmp") or n.endswith(".manifest.json.tmp"):
+            try:
+                os.remove(os.path.join(ckpt_dir, n))
+                removed.append(n)
+            except OSError:  # tpu-dist: ignore[TD006] — best-effort sweep
+                pass
+    return removed
 
 
 def save(
@@ -257,18 +332,80 @@ class AsyncCheckpointer:
         return os.path.join(ckpt_dir, "ckpt_best.npz")
 
 
-def latest_checkpoint(ckpt_dir: str) -> Optional[Tuple[str, int]]:
-    """Returns ``(path, epoch)`` of the newest complete checkpoint."""
+def all_checkpoints(ckpt_dir: str) -> List[Tuple[str, int]]:
+    """Every epoch checkpoint in ``ckpt_dir``, newest first — the restore
+    ladder's walk order. ``*.tmp`` (torn) and ``*.corrupt`` (quarantined)
+    files never appear (the name regex is anchored on ``.npz``)."""
     if not os.path.isdir(ckpt_dir):
-        return None
-    best = None
+        return []
+    found = []
     for name in os.listdir(ckpt_dir):
         m = _CKPT_RE.search(name)
         if m:
-            e = int(m.group(1))
-            if best is None or e > best[1]:
-                best = (os.path.join(ckpt_dir, name), e)
-    return best
+            found.append((os.path.join(ckpt_dir, name), int(m.group(1))))
+    return sorted(found, key=lambda pe: pe[1], reverse=True)
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[Tuple[str, int]]:
+    """Returns ``(path, epoch)`` of the newest complete checkpoint."""
+    ladder = all_checkpoints(ckpt_dir)
+    return ladder[0] if ladder else None
+
+
+def quarantine(path: str) -> str:
+    """Move a corrupt/unreadable checkpoint file out of the resume path by
+    renaming it to ``*.corrupt`` (uniquified). The file is kept for
+    forensics — prune sweeps skip quarantined names — but no discovery
+    function will ever report it as a checkpoint again."""
+    dst = path + ".corrupt"
+    i = 1
+    while os.path.exists(dst):
+        dst = f"{path}.corrupt.{i}"
+        i += 1
+    os.replace(path, dst)
+    return dst
+
+
+def verify_npz(path: str) -> dict:
+    """Integrity-check one plain checkpoint: the archive must be readable
+    end to end and every entry must match its CRC32 stamp in ``__meta__``
+    (checkpoints written before stamping existed get the structural check
+    only). Returns the meta dict; raises :class:`CheckpointCorruptError`."""
+    try:
+        with np.load(path) as z:
+            meta = {}
+            if "__meta__" in z.files:
+                meta = json.loads(bytes(z["__meta__"].tobytes()).decode())
+            crcs = meta.get("crc32")
+            if crcs is not None:
+                missing = set(crcs) - set(z.files)
+                if missing:
+                    raise CheckpointCorruptError(
+                        f"{path}: stamped entries missing from archive: "
+                        f"{sorted(missing)[:4]}"
+                    )
+            for k in z.files:
+                if k == "__meta__":
+                    continue
+                arr = z[k]  # full decompress: zip-level CRC checked here
+                if crcs is not None:
+                    want = crcs.get(k)
+                    if want is None:
+                        raise CheckpointCorruptError(
+                            f"{path}: entry {k!r} has no CRC stamp"
+                        )
+                    if _entry_crc(arr) != int(want) & 0xFFFFFFFF:
+                        raise CheckpointCorruptError(
+                            f"{path}: CRC32 mismatch on entry {k!r} — "
+                            "silent corruption"
+                        )
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:  # BadZipFile / zlib.error / OSError / EOF / json
+        raise CheckpointCorruptError(
+            f"unreadable checkpoint {path}: {type(e).__name__}: {e}"
+        ) from e
+    return meta
 
 
 def read_meta(path: str) -> dict:
@@ -279,14 +416,46 @@ def read_meta(path: str) -> dict:
         return json.loads(bytes(z["__meta__"].tobytes()).decode())
 
 
-def restore(path: str, template: TrainState) -> TrainState:
+def restore(path: str, template: TrainState, verify: bool = False) -> TrainState:
     """Rebuild a TrainState shaped like ``template`` from ``path``.
 
     Arrays come back as host numpy; the caller re-places them on the mesh
-    (the trainer does this when resuming).
+    (the trainer does this when resuming). ``verify=True`` CRC-checks each
+    entry against its ``__meta__`` stamp AS IT IS READ — same coverage as
+    :func:`verify_npz` in the single decompression pass the restore does
+    anyway (a separate verify-then-restore would read the archive twice).
     """
     with np.load(path) as z:
-        flat = {k: z[k] for k in z.files if k != "__meta__"}
+        crcs = None
+        if verify:
+            meta = {}
+            if "__meta__" in z.files:
+                meta = json.loads(bytes(z["__meta__"].tobytes()).decode())
+            crcs = meta.get("crc32")
+            if crcs is not None:
+                missing = set(crcs) - set(z.files)
+                if missing:
+                    raise CheckpointCorruptError(
+                        f"{path}: stamped entries missing from archive: "
+                        f"{sorted(missing)[:4]}"
+                    )
+        flat = {}
+        for k in z.files:
+            if k == "__meta__":
+                continue
+            arr = z[k]
+            if crcs is not None:
+                want = crcs.get(k)
+                if want is None:
+                    raise CheckpointCorruptError(
+                        f"{path}: entry {k!r} has no CRC stamp"
+                    )
+                if _entry_crc(arr) != int(want) & 0xFFFFFFFF:
+                    raise CheckpointCorruptError(
+                        f"{path}: CRC32 mismatch on entry {k!r} — silent "
+                        "corruption"
+                    )
+            flat[k] = arr
     d: Any = _unflatten(template._asdict(), flat)
     return TrainState(**d)
 
@@ -398,13 +567,24 @@ def save_sharded(
             if pid == 0:
                 data = np.asarray(leaf)
                 shard_flat[_shard_key(key, (), data.shape)] = data
+    # self-describing integrity: each shard carries the CRC32 of its own
+    # entries (rank 0 cannot know other processes' bytes for the manifest)
+    shard_flat["__crc__"] = np.frombuffer(
+        json.dumps({k: _entry_crc(v) for k, v in shard_flat.items()}).encode(),
+        dtype=np.uint8,
+    )
     name = f"{stem}.shard{pid}of{nproc}.npz"
     tmp = os.path.join(ckpt_dir, name + ".tmp")
-    # tpu-dist: ignore[TD002] — sharded format: EVERY process writes its own
-    # shard piece by design; the rank-0-only commit is the manifest below
-    with open(tmp, "wb") as f:
-        np.savez(f, **shard_flat)
-    os.replace(tmp, os.path.join(ckpt_dir, name))
+
+    def write_shard() -> None:
+        faults.on_ckpt_write()  # --fault_plan injection point (no-op off)
+        # tpu-dist: ignore[TD002] — sharded format: EVERY process writes its
+        # own shard piece by design; the rank-0-only commit is the manifest
+        with open(tmp, "wb") as f:
+            np.savez(f, **shard_flat)
+        os.replace(tmp, os.path.join(ckpt_dir, name))
+
+    retry_lib.retry_call(write_shard, retries=_IO_RETRIES, describe=f"write of {name}")
 
     # the manifest is the commit marker: all shard files must exist first
     if nproc > 1:
@@ -418,10 +598,21 @@ def save_sharded(
         meta.update(extra_meta)
     manifest = {"meta": meta, "n_shards": nproc, "shapes": shapes}
     tmp = mpath + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(manifest, f)
-    os.replace(tmp, mpath)
+
+    def write_manifest() -> None:
+        faults.on_ckpt_write()
+        # tpu-dist: ignore[TD002] — save_sharded returned above unless
+        # pid == 0; the manifest commit is rank-0-only by construction
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, mpath)
+
+    retry_lib.retry_call(
+        write_manifest, retries=_IO_RETRIES, describe=f"commit of {stem}"
+    )
+    faults.on_ckpt_published(mpath)
     if keep_last is not None and keep_last > 0:
+        sweep_stale_tmp(ckpt_dir)  # post-commit barrier: no write in flight
         committed = sorted(
             int(m.group(1))
             for m in (_MANIFEST_RE.search(n_) for n_ in os.listdir(ckpt_dir))
@@ -436,11 +627,13 @@ def save_sharded(
             key=lambda n_: (0 if n_.endswith(".manifest.json") else 1, n_),
         )
         for n_ in names:
+            if n_.endswith(".corrupt") or ".corrupt." in n_:
+                continue  # quarantined files are kept for forensics
             m = _NUMERIC_CKPT_FILE_RE.match(n_)
             if m and int(m.group(1)) not in kept:
                 try:
                     os.remove(os.path.join(ckpt_dir, n_))
-                except OSError:
+                except OSError:  # tpu-dist: ignore[TD006] — best-effort prune
                     pass
     return mpath
 
@@ -462,18 +655,83 @@ class ShardedCheckpointer:
         return save_sharded(ckpt_dir, state, epoch, extra_meta=em, stem="ckpt_best")
 
 
-def latest_sharded_checkpoint(ckpt_dir: str) -> Optional[Tuple[str, int]]:
-    """Newest COMMITTED sharded checkpoint: ``(manifest_path, epoch)``."""
+def all_sharded_checkpoints(ckpt_dir: str) -> List[Tuple[str, int]]:
+    """Every COMMITTED sharded checkpoint, newest first (manifest paths)."""
     if not os.path.isdir(ckpt_dir):
-        return None
-    best = None
+        return []
+    found = []
     for nm in os.listdir(ckpt_dir):
         m = _MANIFEST_RE.search(nm)
         if m:
-            e = int(m.group(1))
-            if best is None or e > best[1]:
-                best = (os.path.join(ckpt_dir, nm), e)
-    return best
+            found.append((os.path.join(ckpt_dir, nm), int(m.group(1))))
+    return sorted(found, key=lambda pe: pe[1], reverse=True)
+
+
+def latest_sharded_checkpoint(ckpt_dir: str) -> Optional[Tuple[str, int]]:
+    """Newest COMMITTED sharded checkpoint: ``(manifest_path, epoch)``."""
+    ladder = all_sharded_checkpoints(ckpt_dir)
+    return ladder[0] if ladder else None
+
+
+def verify_sharded(manifest_path: str, deep: bool = True) -> dict:
+    """Integrity-check a committed sharded checkpoint: readable manifest,
+    the full expected shard-file set, every shard archive readable, every
+    stamped entry present, and (``deep=True``) every entry matching its
+    shard's ``__crc__`` stamp (pre-stamp shards get the structural checks
+    only). ``deep=False`` stops at the archive directories — the
+    O(1/n)-per-process choice for multi-process restores, where each
+    process would otherwise decompress the WHOLE checkpoint n times
+    (restore itself still surfaces piece-level corruption to the ladder).
+    Returns the manifest meta; raises :class:`CheckpointCorruptError`."""
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        n = manifest["n_shards"]
+        ckpt_dir = os.path.dirname(manifest_path)
+        stem = os.path.basename(manifest_path)[: -len(".manifest.json")]
+        shard_names = sorted(
+            nm
+            for nm in os.listdir(ckpt_dir)
+            if nm.startswith(f"{stem}.shard") and nm.endswith(f"of{n}.npz")
+        )
+        if len(shard_names) != n:
+            raise CheckpointCorruptError(
+                f"{manifest_path}: expects {n} shard files, found "
+                f"{len(shard_names)} — torn or partially-pruned checkpoint"
+            )
+        for nm in shard_names:
+            spath = os.path.join(ckpt_dir, nm)
+            with np.load(spath) as z:
+                crcs = None
+                if "__crc__" in z.files:
+                    crcs = json.loads(bytes(z["__crc__"].tobytes()).decode())
+                if crcs is not None:
+                    missing = set(crcs) - set(z.files) - {"__crc__"}
+                    if missing:
+                        raise CheckpointCorruptError(
+                            f"{spath}: stamped entries missing from "
+                            f"archive: {sorted(missing)[:4]}"
+                        )
+                if not deep:
+                    continue  # zip directory read above is the cheap check
+                for k in z.files:
+                    if k == "__crc__":
+                        continue
+                    arr = z[k]
+                    if crcs is not None:
+                        want = crcs.get(k)
+                        if want is None or _entry_crc(arr) != int(want) & 0xFFFFFFFF:
+                            raise CheckpointCorruptError(
+                                f"{spath}: CRC32 mismatch on {k!r}"
+                            )
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"unreadable sharded checkpoint {manifest_path}: "
+            f"{type(e).__name__}: {e}"
+        ) from e
+    return manifest["meta"]
 
 
 def read_sharded_meta(manifest_path: str) -> dict:
@@ -509,9 +767,15 @@ def restore_sharded(manifest_path: str, template: TrainState) -> TrainState:
     pieces: dict = {}
     for z in zips:
         for skey in z.files:
+            if skey == "__crc__":  # per-shard integrity stamp, not a piece
+                continue
             key, origin, extent = _parse_shard_key(skey)
             if key not in shapes:
-                raise KeyError(f"shard key {key} not in manifest")
+                # a shard/manifest mismatch is corruption, not a template
+                # mismatch — typed so the restore ladder can quarantine it
+                raise CheckpointCorruptError(
+                    f"shard key {key} not in manifest {manifest_path}"
+                )
             pieces.setdefault(key, []).append((origin, extent, z, skey))
 
     def assemble(key, origin, extent, dtype):
@@ -538,7 +802,9 @@ def restore_sharded(manifest_path: str, template: TrainState) -> TrainState:
             buf[dst] = data[src]
             covered += int(np.prod([h - l for l, h in zip(lo, hi)]))
         if buf is None or covered < int(np.prod(extent)):
-            raise KeyError(
+            # the manifest committed this leaf but the shard set cannot
+            # rebuild it: lost/partial shard data — ladder-quarantinable
+            raise CheckpointCorruptError(
                 f"sharded checkpoint does not cover {key}"
                 f"[{origin}:+{extent}] (covered {covered} elements)"
             )

@@ -19,6 +19,14 @@ Usage::
 Injects ``--num_processes/--process_id/--ip/--port`` into the child command
 line (the reference injects ``--local_rank``, ``distributed.py:18-25``) and
 propagates failures: first child to die non-zero kills the rest.
+
+Preemption contract (docs/resilience.md): a SIGTERM to the launcher is
+FORWARDED to every child — each trainer finishes its in-flight step, runs
+the emergency snapshot, and exits ``PREEMPTION_EXIT_CODE`` — and the
+launcher then exits with that same distinct code (75, EX_TEMPFAIL) so the
+orchestrator can requeue instead of treating preemption as a crash. A
+child that exits with the preemption code on its own (e.g. a per-host
+SIGTERM) propagates it the same way.
 """
 
 from __future__ import annotations
@@ -30,6 +38,8 @@ import socket
 import subprocess
 import sys
 from typing import List, Optional, Sequence
+
+from tpu_dist.resilience.preemption import PREEMPTION_EXIT_CODE
 
 
 def _free_port() -> int:
@@ -58,6 +68,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     port = args.port or _free_port()
 
     procs: List[subprocess.Popen] = []
+    preempted = [False]
+
+    def _forward_sigterm(signum, frame):  # noqa: ARG001
+        # graceful fan-out: children run their own SIGTERM discipline
+        # (emergency snapshot + distinct exit code); we keep waiting for
+        # them below instead of dying and orphaning the job
+        preempted[0] = True
+        for pr in list(procs):
+            try:
+                pr.send_signal(signal.SIGTERM)
+            except OSError:  # tpu-dist: ignore[TD006] — child already gone
+                pass
+
+    try:
+        prev_term = signal.signal(signal.SIGTERM, _forward_sigterm)
+    except ValueError:  # not the main thread (embedded use) — skip
+        prev_term = None
     try:
         for rank in range(args.nproc):
             env = dict(os.environ)
@@ -77,23 +104,41 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             procs.append(subprocess.Popen(child, env=env))
 
         rc = 0
+        crash_rc = 0  # first exit that is neither clean, preemption, nor
+        # death-by-our-own-SIGTERM — a REAL failure that must never be
+        # reported as "requeue me"
         while procs:
             for pr in list(procs):
                 ret = pr.poll()
                 if ret is None:
                     continue
                 procs.remove(pr)
+                if ret == PREEMPTION_EXIT_CODE:
+                    preempted[0] = True
+                elif ret not in (0, -signal.SIGTERM) and crash_rc == 0:
+                    crash_rc = ret
                 if ret != 0 and rc == 0:
                     rc = ret
-                    for other in procs:  # fail fast like torchrun
+                    for other in procs:  # fail fast like torchrun — which,
+                        # with the trainer's cooperative handler installed,
+                        # is a GRACEFUL shutdown request, not a kill
                         other.send_signal(signal.SIGTERM)
             if procs:
                 try:
                     procs[0].wait(timeout=1)
                 except subprocess.TimeoutExpired:
                     pass
+        if crash_rc:
+            return crash_rc  # a crash outranks a concurrent preemption
+        if preempted[0] and rc in (0, PREEMPTION_EXIT_CODE, -signal.SIGTERM):
+            # the whole job was preempted (not crashed): surface the
+            # distinct requeue-me code even if some child died on the raw
+            # signal before its handler was installed
+            return PREEMPTION_EXIT_CODE
         return rc
     finally:
+        if prev_term is not None:
+            signal.signal(signal.SIGTERM, prev_term)
         for pr in procs:
             pr.kill()
 

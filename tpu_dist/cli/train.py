@@ -32,6 +32,10 @@ def main(argv: Optional[Sequence[str]] = None, **preset) -> None:
     args = parser.parse_args(argv)
     cfg = config_from_args(args, **preset)
 
+    from tpu_dist.resilience.preemption import (  # noqa: PLC0415
+        PREEMPTION_EXIT_CODE,
+        PreemptedError,
+    )
     from tpu_dist.train.trainer import Trainer  # lazy: jax init after parse
 
     trainer = Trainer(cfg)
@@ -40,7 +44,14 @@ def main(argv: Optional[Sequence[str]] = None, **preset) -> None:
         f"global_batch={cfg.batch_size} bf16={cfg.bf16} sync_bn={cfg.sync_bn} "
         f"grad_accu_steps={cfg.grad_accu_steps}"
     )
-    trainer.fit()
+    try:
+        trainer.fit()
+    except PreemptedError as e:
+        # graceful preemption: the emergency snapshot discipline already ran
+        # inside fit(); exit with the distinct requeue-me code instead of
+        # dying on the signal (launch.py propagates it)
+        rank0_print(f"=> preempted: {e}; exiting {PREEMPTION_EXIT_CODE}")
+        raise SystemExit(PREEMPTION_EXIT_CODE) from None
 
 
 if __name__ == "__main__":

@@ -115,6 +115,16 @@ class TrainConfig:
     sharded_ckpt: bool = False     # per-process shard files + rank-0 manifest;
                                    # no gather at save time (FSDP/ZeRO scale)
 
+    # -- resilience (docs/resilience.md) ------------------------------------
+    ckpt_verify: bool = True       # CRC32-verify checkpoints at restore and
+                                   # walk newest→oldest past quarantined
+                                   # (*.corrupt) files instead of raising
+    ckpt_io_retries: int = 2       # transient ckpt-write retries (exponential
+                                   # backoff, deterministic delays; 0 = off)
+    fault_plan: Optional[str] = None  # deterministic fault-injection spec
+                                   # (chaos testing; env TPU_DIST_FAULT_PLAN
+                                   # when unset — resilience/faults.py)
+
     # -- bench / smoke / debug ---------------------------------------------
     steps_per_epoch: Optional[int] = None  # cap steps (smoke tests / benches)
     debug_replica_check: bool = False  # assert params replicated each epoch
@@ -269,6 +279,24 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "scale choice; mutually exclusive with --async_ckpt "
                         "(each process's write is already 1/n-sized, so the "
                         "background-thread overlap buys little)")
+    p.add_argument("--ckpt_verify", dest="ckpt_verify", action="store_true",
+                   default=d.ckpt_verify,
+                   help="verify per-entry CRC32 stamps at restore and fall "
+                        "back newest→oldest past corrupt checkpoints "
+                        "(quarantined to *.corrupt) — the default")
+    p.add_argument("--no_ckpt_verify", dest="ckpt_verify", action="store_false",
+                   help="restore the newest checkpoint unverified (a corrupt "
+                        "file still falls back, but silent bit-flips pass)")
+    p.add_argument("--ckpt_io_retries", type=int, default=d.ckpt_io_retries,
+                   metavar="N",
+                   help="retry transient checkpoint-write failures "
+                        "(OSError/EIO/ENOSPC-style) up to N times with "
+                        "deterministic exponential backoff; 0 disables")
+    p.add_argument("--fault_plan", type=str, default=d.fault_plan,
+                   help="deterministic fault-injection plan for chaos "
+                        "testing, e.g. 'ckpt_write@call=1:times=2;"
+                        "sigterm@epoch=1:step=5' (docs/resilience.md; env "
+                        "TPU_DIST_FAULT_PLAN when the flag is unset)")
     p.add_argument("--log_file", type=str, default=None,
                    help="JSONL metrics history path (rank 0)")
     p.add_argument("--tensorboard_dir", type=str, default=None,

@@ -26,6 +26,14 @@ from jax.sharding import Mesh
 
 from tpu_dist.comm import mesh as mesh_lib
 from tpu_dist.data.sampler import DistributedSampler
+from tpu_dist.resilience import faults
+
+
+class LoaderProducerDiedError(RuntimeError):
+    """The prefetch producer thread died without finishing the epoch (and
+    without surfacing an exception) — e.g. killed at interpreter teardown.
+    Raised by the consumer watchdog instead of blocking on ``q.get()``
+    forever (docs/resilience.md)."""
 
 
 class DataLoader:
@@ -44,6 +52,7 @@ class DataLoader:
         with_mask: bool = False,
         batch_divisor: Optional[int] = None,
         shard_axes=mesh_lib.DATA_AXIS,
+        watchdog_timeout: float = 5.0,
     ):
         """``batch_size`` is the PER-PROCESS batch (the reference's manual
         ``global_batch / nprocs`` split, ``distributed.py:67``, happens in
@@ -53,7 +62,13 @@ class DataLoader:
         ``gather_transform(images, sel, seed=...)`` is the fused fast path
         (gather + augment + normalize in one pass — the native C++ pipeline,
         ``tpu_dist.data.native.gather_augment``); when given it replaces
-        ``transform``/``eval_transform``."""
+        ``transform``/``eval_transform``.
+
+        ``watchdog_timeout`` is the consumer's poll period (seconds) for
+        noticing a DEAD producer thread: a slow producer just keeps the
+        consumer polling, but a producer that died without its end-of-epoch
+        sentinel raises :class:`LoaderProducerDiedError` within one tick
+        instead of hanging the epoch forever."""
         n_local = batch_divisor or mesh_lib.local_device_count()
         if batch_size % n_local:
             raise ValueError(
@@ -72,6 +87,7 @@ class DataLoader:
         self.prefetch = max(1, prefetch)
         self.with_mask = with_mask
         self.shard_axes = shard_axes
+        self.watchdog_timeout = watchdog_timeout
 
     def __len__(self) -> int:
         return len(self.sampler) // self.batch_size if self.sampler.drop_last else -(
@@ -134,10 +150,19 @@ class DataLoader:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         err = []
         stop = threading.Event()
+        killed = []  # --fault_plan loader_stall: producer died, no sentinel
 
         def producer():
             try:
-                for hb in self._host_batches(start_batch):
+                for b, hb in enumerate(
+                    self._host_batches(start_batch), start=start_batch
+                ):
+                    if faults.on_loader_batch(b, self.sampler.epoch) == "die":
+                        # simulate a producer killed mid-epoch: exit WITHOUT
+                        # the end-of-epoch sentinel (the consumer watchdog
+                        # below must notice, not hang)
+                        killed.append(b)
+                        return
                     batch = mesh_lib.shard_batch(self.mesh, hb, self.shard_axes)
                     # bounded put that notices consumer abandonment (e.g. the
                     # trainer's steps_per_epoch early break) instead of
@@ -153,14 +178,29 @@ class DataLoader:
             except Exception as e:  # surfaced on the consumer side
                 err.append(e)
             finally:
-                if not stop.is_set():
+                if not stop.is_set() and not killed:
                     q.put(None)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
         try:
             while True:
-                item = q.get()
+                try:
+                    item = q.get(timeout=self.watchdog_timeout)
+                except queue.Empty:
+                    # watchdog: only a DEAD producer with a drained queue is
+                    # a failure — nothing can arrive anymore (a live-but-slow
+                    # producer just keeps us polling)
+                    if not t.is_alive() and q.empty():
+                        if err:
+                            raise err[0]
+                        raise LoaderProducerDiedError(
+                            "DataLoader producer thread died without "
+                            "finishing the epoch (no sentinel, no error) — "
+                            "likely killed mid-epoch; restart the epoch "
+                            "instead of waiting on q.get() forever"
+                        )
+                    continue
                 if item is None:
                     break
                 yield item

@@ -1,0 +1,40 @@
+"""Resilience subsystem: deterministic fault injection, preemption-graceful
+shutdown, and transient-I/O retry (docs/resilience.md).
+
+Pod-scale TPU practice treats preemption and restart as the steady state
+(MLPerf on TPU-v3 pods, arXiv:1909.09756; concurrency-limits on TPUs,
+arXiv:2011.03641), so failure handling here is a *tested subsystem*, not
+prose:
+
+* :mod:`tpu_dist.resilience.faults` — a seeded, config/env-driven fault
+  plan (``--fault_plan`` / ``TPU_DIST_FAULT_PLAN``) that can raise
+  ``OSError`` from the k-th checkpoint write, truncate or bit-flip a
+  published checkpoint, poison the loss with NaN, kill the data-loader
+  producer, and deliver a real ``SIGTERM`` — all through host-side
+  injection points that are no-ops when no plan is installed (the TD105
+  jaxpr audit asserts the traced step is byte-identical either way).
+* :mod:`tpu_dist.resilience.preemption` — cooperative SIGTERM handling:
+  the handler sets a flag, the trainer finishes the in-flight step, runs
+  the emergency-save discipline, and the process exits with
+  :data:`PREEMPTION_EXIT_CODE` (propagated by ``cli/launch.py``).
+* :mod:`tpu_dist.resilience.retry` — exponential-backoff retry with
+  deterministic delays and an injectable sleep, wrapped around the
+  checkpoint writers (``--ckpt_io_retries``).
+
+This package must stay import-light (no jax): the fault hooks sit on hot
+host paths and the analysis CLI imports rule metadata without a backend.
+"""
+
+from tpu_dist.resilience.faults import (  # noqa: F401
+    FaultPlan,
+    FaultPlanError,
+    active,
+    clear,
+    configure,
+    install,
+)
+from tpu_dist.resilience.preemption import (  # noqa: F401
+    PREEMPTION_EXIT_CODE,
+    PreemptedError,
+)
+from tpu_dist.resilience.retry import retry_call  # noqa: F401

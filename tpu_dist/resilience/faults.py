@@ -1,0 +1,341 @@
+"""Deterministic fault injection — the chaos harness behind ``--fault_plan``.
+
+A *fault plan* is a semicolon-separated list of clauses::
+
+    site@key=value[:key=value...]
+
+Every trigger is a **deterministic coordinate** — a call count, an epoch, a
+step, a batch index — never wall-clock time, so a plan replays identically
+run after run (the property the bit-identical chaos tests in
+``tests/test_resilience.py`` rely on). Sites:
+
+``ckpt_write@call=K[:times=N][:errno=5]``
+    Raise ``OSError(errno)`` from the K-th ``_write_npz``/shard write call
+    (1-based, counted process-wide), for N consecutive calls (default 1).
+    With ``--ckpt_io_retries`` the write succeeds once the clause is
+    exhausted — the transient-EIO story.
+``ckpt_corrupt@epoch=E[:mode=truncate|bitflip][:seed=S][:frac=0.5]``
+    After ``ckpt_E.npz`` (or its sharded manifest) publishes, truncate it
+    to ``frac`` of its bytes or flip 8 seeded bits in place — the torn /
+    silently-corrupted newest checkpoint the restore ladder must survive.
+``nan_loss@step=S[:epoch=E]``
+    Report a NaN training loss at step S (of epoch E; any epoch when
+    omitted) — drives the existing NaN-guard/auto-recover path.
+``sigterm@step=S[:epoch=E]``
+    Deliver a **real** ``SIGTERM`` to this process at step S — exercises
+    the preemption-graceful shutdown end to end, signal delivery included.
+``loader_stall@batch=B[:epoch=E]``
+    Kill the data-loader producer thread before it publishes batch B
+    (it exits without its end-of-epoch sentinel, exactly like a thread
+    torn down at interpreter shutdown) — the consumer watchdog must turn
+    this into a clear error instead of hanging the epoch.
+
+Each clause fires ``times`` times (default 1) and then disarms. Injection
+points call the ``on_*`` hooks below; with no plan installed every hook is
+a single attribute read + ``None`` check — and all hooks are host-side, so
+the traced train step is unchanged whether or not a plan is armed (audited
+by TD105 in ``tpu_dist.analysis``).
+
+This module must not import jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import signal
+from typing import Dict, FrozenSet, List, Optional
+
+ENV_VAR = "TPU_DIST_FAULT_PLAN"
+
+# action names surfaced to the trainer by on_step()
+NAN_LOSS = "nan_loss"
+SIGTERM = "sigterm"
+
+SITES = ("ckpt_write", "ckpt_corrupt", "nan_loss", "sigterm", "loader_stall")
+
+_CKPT_NAME_RE = re.compile(r"ckpt_(\d+)\.(?:npz|manifest\.json)$")
+
+_INT_KEYS = {"call", "times", "errno", "epoch", "step", "batch", "seed"}
+_ALLOWED_KEYS = {
+    "ckpt_write": {"call", "times", "errno"},
+    "ckpt_corrupt": {"epoch", "mode", "seed", "frac", "times"},
+    "nan_loss": {"step", "epoch", "times"},
+    "sigterm": {"step", "epoch", "times"},
+    "loader_stall": {"batch", "epoch", "times"},
+}
+_REQUIRED_KEYS = {
+    "ckpt_write": {"call"},
+    "ckpt_corrupt": {"epoch"},
+    "nan_loss": {"step"},
+    "sigterm": {"step"},
+    "loader_stall": {"batch"},
+}
+
+
+class FaultPlanError(ValueError):
+    """Malformed ``--fault_plan`` spec."""
+
+
+@dataclasses.dataclass
+class FaultClause:
+    site: str
+    params: Dict[str, object]
+    fired: int = 0
+
+    @property
+    def times(self) -> int:
+        return int(self.params.get("times", 1))
+
+    def armed(self) -> bool:
+        return self.fired < self.times
+
+    def matches(self, **coords) -> bool:
+        """Armed AND every coordinate the clause pins equals the site's
+        current coordinate (params absent from ``coords`` are ignored —
+        e.g. ``epoch`` left unpinned matches every epoch)."""
+        if not self.armed():
+            return False
+        for key, want in self.params.items():
+            if key in ("times", "mode", "seed", "frac", "errno"):
+                continue
+            if key in coords and coords[key] != want:
+                return False
+        return True
+
+
+class FaultPlan:
+    """Parsed fault plan + the per-site deterministic counters."""
+
+    def __init__(self, clauses: List[FaultClause], spec: str = ""):
+        self.clauses = clauses
+        self.spec = spec
+        self.ckpt_write_calls = 0  # process-wide _write_npz call counter
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        clauses: List[FaultClause] = []
+        for raw in spec.split(";"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            if "@" not in raw:
+                raise FaultPlanError(
+                    f"fault clause {raw!r} has no trigger — expected "
+                    "site@key=value[:key=value...]"
+                )
+            site, _, rest = raw.partition("@")
+            site = site.strip()
+            if site not in SITES:
+                raise FaultPlanError(
+                    f"unknown fault site {site!r}; have {SITES}"
+                )
+            params: Dict[str, object] = {}
+            for kv in rest.split(":"):
+                if "=" not in kv:
+                    raise FaultPlanError(
+                        f"fault clause {raw!r}: bad parameter {kv!r} "
+                        "(expected key=value)"
+                    )
+                key, _, val = kv.partition("=")
+                key = key.strip()
+                if key not in _ALLOWED_KEYS[site]:
+                    raise FaultPlanError(
+                        f"fault site {site!r} does not take {key!r}; "
+                        f"allowed: {sorted(_ALLOWED_KEYS[site])}"
+                    )
+                if key in _INT_KEYS:
+                    try:
+                        params[key] = int(val)
+                    except ValueError as e:
+                        raise FaultPlanError(
+                            f"fault clause {raw!r}: {key} must be an "
+                            f"integer, got {val!r}"
+                        ) from e
+                elif key == "frac":
+                    params[key] = float(val)
+                else:
+                    params[key] = val.strip()
+            missing = _REQUIRED_KEYS[site] - set(params)
+            if missing:
+                raise FaultPlanError(
+                    f"fault clause {raw!r} is missing required "
+                    f"parameter(s) {sorted(missing)}"
+                )
+            mode = params.get("mode", "truncate")
+            if site == "ckpt_corrupt" and mode not in ("truncate", "bitflip"):
+                raise FaultPlanError(
+                    f"ckpt_corrupt mode must be truncate|bitflip, got {mode!r}"
+                )
+            clauses.append(FaultClause(site, params))
+        if not clauses:
+            raise FaultPlanError(f"fault plan {spec!r} contains no clauses")
+        return cls(clauses, spec)
+
+    def _matching(self, site: str, **coords) -> List[FaultClause]:
+        return [
+            c for c in self.clauses if c.site == site and c.matches(**coords)
+        ]
+
+
+# --------------------------------------------------------------------------
+# Module-level plan registry (one plan per process, like the jax config
+# globals this package already uses for the compile cache).
+# --------------------------------------------------------------------------
+
+_PLAN: Optional[FaultPlan] = None
+
+
+def install(plan) -> FaultPlan:
+    """Install a :class:`FaultPlan` (or parse a spec string) as THE active
+    plan; returns it. Counters start fresh."""
+    global _PLAN
+    _PLAN = plan if isinstance(plan, FaultPlan) else FaultPlan.parse(plan)
+    return _PLAN
+
+
+def clear() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def active() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def configure(spec: Optional[str]) -> Optional[FaultPlan]:
+    """Config-layer entry point (the Trainer calls this once per
+    construction): install ``spec``, falling back to ``$TPU_DIST_FAULT_PLAN``
+    when None; with neither set, any previously-installed plan is CLEARED —
+    a resumed run without ``--fault_plan`` must not replay the crashed
+    run's faults."""
+    spec = spec or os.environ.get(ENV_VAR)
+    if spec:
+        return install(spec)
+    clear()
+    return None
+
+
+# --------------------------------------------------------------------------
+# Injection hooks. Zero-cost when off: one global read + None check.
+# --------------------------------------------------------------------------
+
+
+def on_ckpt_write() -> None:
+    """Called at the top of every checkpoint file write attempt (plain npz,
+    shard file, manifest). Raises the injected ``OSError`` when an armed
+    ``ckpt_write`` clause covers this call count. Retried attempts count as
+    new calls, so ``call=1:times=2`` fails the first two ATTEMPTS — a
+    2-retry ladder then succeeds on the third."""
+    plan = _PLAN
+    if plan is None:
+        return
+    plan.ckpt_write_calls += 1
+    for c in plan.clauses:
+        if c.site != "ckpt_write" or not c.armed():
+            continue
+        first = int(c.params["call"])
+        if first <= plan.ckpt_write_calls < first + c.times:
+            c.fired += 1
+            eno = int(c.params.get("errno", 5))  # EIO
+            raise OSError(
+                eno,
+                f"[fault-injected] checkpoint write failure "
+                f"(call {plan.ckpt_write_calls}, clause {c.params})",
+            )
+
+
+def on_ckpt_published(path: str) -> Optional[str]:
+    """Called after a checkpoint file is atomically published. Corrupts the
+    file in place when an armed ``ckpt_corrupt`` clause matches its epoch;
+    returns the corruption mode applied (for logging) or None."""
+    plan = _PLAN
+    if plan is None:
+        return None
+    m = _CKPT_NAME_RE.search(os.path.basename(path))
+    if not m:
+        return None
+    epoch = int(m.group(1))
+    for c in plan._matching("ckpt_corrupt", epoch=epoch):
+        c.fired += 1
+        mode = str(c.params.get("mode", "truncate"))
+        if mode == "truncate":
+            truncate_file(path, frac=float(c.params.get("frac", 0.5)))
+        else:
+            bitflip_file(path, seed=int(c.params.get("seed", 0)))
+        return mode
+    return None
+
+
+def on_step(epoch: int, step: int) -> FrozenSet[str]:
+    """Called once per completed train step (host side). Returns the set of
+    actions the trainer must apply ({'nan_loss'}); a matching ``sigterm``
+    clause delivers a REAL signal to this process right here."""
+    plan = _PLAN
+    if plan is None:
+        return frozenset()
+    actions = set()
+    for c in plan._matching("nan_loss", epoch=epoch, step=step):
+        c.fired += 1
+        actions.add(NAN_LOSS)
+    for c in plan._matching("sigterm", epoch=epoch, step=step):
+        c.fired += 1
+        actions.add(SIGTERM)
+        os.kill(os.getpid(), signal.SIGTERM)
+    return frozenset(actions)
+
+
+def on_loader_batch(batch: int, epoch: Optional[int] = None) -> Optional[str]:
+    """Called by the loader's producer thread before publishing ``batch``.
+    Returns ``'die'`` when an armed ``loader_stall`` clause matches — the
+    producer then exits without its sentinel, simulating a thread killed
+    mid-epoch."""
+    plan = _PLAN
+    if plan is None:
+        return None
+    coords = {"batch": batch}
+    if epoch is not None:
+        coords["epoch"] = epoch
+    for c in plan._matching("loader_stall", **coords):
+        c.fired += 1
+        return "die"
+    return None
+
+
+# --------------------------------------------------------------------------
+# Corruption primitives (also used directly by tests).
+# --------------------------------------------------------------------------
+
+
+def truncate_file(path: str, frac: float = 0.5) -> None:
+    """Truncate ``path`` to ``frac`` of its size — a torn write."""
+    size = os.path.getsize(path)
+    keep = max(1, int(size * frac)) if size else 0
+    # tpu-dist: ignore[TD002] — fault-injection harness: runs only on the
+    # process that owns the file it is deliberately corrupting
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+
+
+def bitflip_file(path: str, seed: int = 0, nbits: int = 8) -> None:
+    """Flip ``nbits`` seeded-pseudo-random bits in the body of ``path`` —
+    silent corruption the zip directory may not notice. Deterministic: a
+    simple LCG over (seed, i), no RNG state, no wall clock."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    # skip the first 64 bytes so the zip magic stays intact and the file
+    # still LOOKS like a checkpoint (the integrity layer must catch it)
+    lo = min(64, size - 1)
+    span = max(1, size - lo)
+    # tpu-dist: ignore[TD002] — fault-injection harness (see truncate_file)
+    with open(path, "r+b") as f:
+        x = (seed * 6364136223846793005 + 1442695040888963407) & (2**64 - 1)
+        for _ in range(nbits):
+            x = (x * 6364136223846793005 + 1442695040888963407) & (2**64 - 1)
+            off = lo + (x >> 33) % span
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ (1 << (x % 8))]))

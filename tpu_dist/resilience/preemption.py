@@ -1,0 +1,82 @@
+"""Preemption-graceful shutdown (SIGTERM → finish step → snapshot → exit).
+
+TPU preemption delivers ``SIGTERM``, not ``KeyboardInterrupt`` — Python's
+default disposition kills the process mid-step and every un-checkpointed
+step is lost. The contract here:
+
+1. :func:`install` swaps in a handler that only sets a flag (the one thing
+   that is async-signal-safe to do; collectives and file I/O are not).
+2. The trainer polls :func:`requested` at the step grain, FINISHES the
+   in-flight step, and raises :class:`PreemptedError`.
+3. ``Trainer.fit`` catches it exactly like ``KeyboardInterrupt``: the
+   ``_emergency_save`` discipline runs (mid-epoch exact snapshot, the
+   poisoned-state and cross-process-sharded refusals included), then the
+   error propagates.
+4. ``cli/train.py`` maps it to :data:`PREEMPTION_EXIT_CODE` and
+   ``cli/launch.py`` propagates that code (and forwards its own SIGTERM to
+   children first) — so an orchestrator can distinguish "preempted, resume
+   me" from a real failure.
+
+``PreemptedError`` subclasses ``BaseException`` (like
+``KeyboardInterrupt``) so stray ``except Exception`` blocks cannot swallow
+a shutdown request.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+#: Process exit code for a preemption-graceful shutdown. 75 = BSD
+#: EX_TEMPFAIL ("temporary failure; user is invited to retry") — exactly
+#: the resume-me semantics, and distinct from both clean exit (0) and the
+#: uncaught-SIGTERM death (128+15) a non-cooperative process shows.
+PREEMPTION_EXIT_CODE = 75
+
+
+class PreemptedError(BaseException):
+    """Cooperative shutdown in progress (SIGTERM observed at a step/epoch
+    boundary). The emergency snapshot has NOT yet run when this is raised —
+    ``Trainer.fit`` runs it on the way out."""
+
+
+_REQUESTED = False
+_NOT_INSTALLED = object()
+
+
+def _handler(signum, frame):  # noqa: ARG001 — signal-handler signature
+    global _REQUESTED
+    _REQUESTED = True  # flag only: nothing else is async-signal-safe
+
+
+def install():
+    """Install the cooperative SIGTERM handler. Returns an opaque token for
+    :func:`restore`. No-op (token still valid) off the main thread, where
+    CPython forbids ``signal.signal`` — a Trainer driven from a worker
+    thread simply keeps the process's existing disposition."""
+    if threading.current_thread() is not threading.main_thread():
+        return _NOT_INSTALLED
+    try:
+        prev = signal.signal(signal.SIGTERM, _handler)
+    except ValueError:  # non-main interpreter contexts
+        return _NOT_INSTALLED
+    return prev
+
+
+def restore(token) -> None:
+    """Undo :func:`install` (pass its return value)."""
+    if token is _NOT_INSTALLED:
+        return
+    signal.signal(
+        signal.SIGTERM, token if token is not None else signal.SIG_DFL
+    )
+
+
+def requested() -> bool:
+    """True once SIGTERM has been observed (sticky until :func:`clear`)."""
+    return _REQUESTED
+
+
+def clear() -> None:
+    global _REQUESTED
+    _REQUESTED = False

@@ -1,0 +1,61 @@
+"""Exponential-backoff retry for transient host I/O (``--ckpt_io_retries``).
+
+Scope is deliberately narrow: *host-side, idempotent* operations — the
+checkpoint writers, whose write-to-temp + atomic-rename discipline makes a
+failed attempt leave nothing behind. Collectives are explicitly out of
+scope (a retried collective on one process deadlocks the others).
+
+Determinism: the delay sequence is ``base_delay * 2**attempt`` capped at
+``max_delay`` — a pure function of the attempt index, no jitter, no wall
+clock reads — and the sleep itself is injectable, so tests assert the
+exact schedule without sleeping.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Tuple, Type
+
+
+def backoff_delays(
+    retries: int, base_delay: float = 0.05, max_delay: float = 2.0
+) -> Tuple[float, ...]:
+    """The deterministic sleep schedule: one entry per retry."""
+    return tuple(
+        min(base_delay * (2.0**i), max_delay) for i in range(max(0, retries))
+    )
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    retries: int = 0,
+    base_delay: float = 0.05,
+    max_delay: float = 2.0,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+    sleep: Optional[Callable[[float], None]] = None,
+    describe: str = "",
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)``; on a ``retry_on`` exception, sleep the
+    next backoff delay and try again, up to ``retries`` extra attempts.
+    The final failure re-raises the last exception unmodified (so callers'
+    error handling — e.g. the emergency-save donation-hazard match — sees
+    the real error, not a wrapper)."""
+    if retries <= 0:
+        return fn(*args, **kwargs)
+    do_sleep = sleep if sleep is not None else time.sleep
+    delays = backoff_delays(retries, base_delay, max_delay)
+    for attempt, delay in enumerate(delays):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            from tpu_dist.metrics.logging import rank0_print  # noqa: PLC0415
+
+            rank0_print(
+                f"WARNING: transient {'I/O' if not describe else describe} "
+                f"failure (attempt {attempt + 1}/{retries + 1}): {e} — "
+                f"retrying in {delay:g}s"
+            )
+            do_sleep(delay)
+    return fn(*args, **kwargs)  # last attempt: errors propagate

@@ -45,6 +45,8 @@ from tpu_dist.data import (
 from tpu_dist.evaluation import validate
 from tpu_dist.metrics import AverageMeter, rank0_print
 from tpu_dist.nn import resnet18, resnet34, resnet50
+from tpu_dist.resilience import faults, preemption
+from tpu_dist.resilience.preemption import PreemptedError
 from tpu_dist.train.optim import SGD, cosine_lr, multistep_lr
 from tpu_dist.train.state import TrainState
 from tpu_dist.train.step import make_eval_step, make_train_step
@@ -132,6 +134,32 @@ class Trainer:
             num_processes=cfg.num_processes,
             process_id=cfg.process_id,
         )
+        if cfg.ckpt_io_retries < 0:
+            raise ValueError(
+                f"ckpt_io_retries must be >= 0, got {cfg.ckpt_io_retries}"
+            )
+        # transient-write retry ladder for every checkpoint file write
+        # (process-global module state, same posture as compile_cache_dir)
+        ckpt_lib.set_io_retries(cfg.ckpt_io_retries)
+        # chaos harness: install the config/env fault plan (clears any plan
+        # a previous Trainer in this process installed — a resumed run
+        # without --fault_plan must not replay the crashed run's faults);
+        # raises FaultPlanError on a malformed spec before training starts
+        plan = faults.configure(cfg.fault_plan)
+        if plan is not None and cfg.fused_epoch:
+            stepwise = sorted(
+                {c.site for c in plan.clauses}
+                & {"nan_loss", "sigterm", "loader_stall"}
+            )
+            if stepwise:
+                raise ValueError(
+                    f"--fault_plan sites {stepwise} act at the step/batch "
+                    "grain, which --fused_epoch compiles away (the whole "
+                    "epoch is one jit call and the streaming loader is "
+                    "bypassed) — they would silently never fire. Use "
+                    "ckpt_write/ckpt_corrupt clauses, or drop --fused_epoch "
+                    "for chaos runs (refusing to silently ignore the plan)"
+                )
         if cfg.sharded_ckpt and cfg.async_ckpt:
             raise ValueError(
                 "--sharded_ckpt and --async_ckpt are mutually exclusive by "
@@ -1012,6 +1040,8 @@ class Trainer:
             self._progress = (new_state, epoch, step + 1, False)
             self.state = new_state
             images_seen += cfg.batch_size
+            if faults.active() is not None:  # zero-cost when no --fault_plan
+                self._apply_step_faults(epoch, step, lr)
             want_save = (
                 cfg.mid_epoch_save_every
                 and cfg.ckpt_dir
@@ -1055,6 +1085,14 @@ class Trainer:
                     f"Epoch:[{epoch}/{cfg.epochs}] step:[{step}/{nb}] "
                     f"lr={lr:.5f} loss={m['loss']:.4f} "
                     f"acc1={m['acc1']:.2f} acc5={m['acc5']:.2f}"
+                )
+            if preemption.requested():
+                # cooperative SIGTERM: the in-flight step is finished and
+                # published in _progress — fit() runs the emergency-save
+                # discipline on the way out (docs/resilience.md)
+                raise PreemptedError(
+                    f"SIGTERM observed at epoch {epoch} after step {step} "
+                    f"— shutting down at the step boundary"
                 )
         jax.block_until_ready(self.state.params)
         # end-of-epoch guard: catches divergence between logged steps BEFORE
@@ -1106,36 +1144,135 @@ class Trainer:
         )
         rank0_print(f"Epoch {epoch} done in {dt:.2f}s ({ips:.0f} img/s)")
         m.update(epoch_time=dt, images_per_sec=ips)
+        if preemption.requested():
+            # the fused epoch has no step grain — the epoch boundary is the
+            # first cooperative point a SIGTERM can be honored at. The epoch
+            # IS complete here (metrics fetched above block on it), so
+            # publish that before raising: _emergency_save must file the
+            # state under THIS epoch, not discard it as "0 steps done"
+            self._progress = (self.state, epoch, 0, True)
+            raise PreemptedError(
+                f"SIGTERM observed during fused epoch {epoch} — shutting "
+                f"down at the epoch boundary"
+            )
         return m
 
     def _lr(self, epoch: int) -> float:
         """Scheduled LR times the auto-recovery backoff scale."""
         return self.lr_schedule(epoch) * self._lr_scale
 
+    def _apply_step_faults(self, epoch: int, step: int, lr: float) -> None:
+        """Host-side --fault_plan actions at the step grain. A matching
+        ``sigterm`` clause delivered a real signal inside ``on_step`` (the
+        loop's preemption check picks it up); ``nan_loss`` reports a
+        divergence through the SAME error type the NaN guard uses, so the
+        existing auto-recover machinery runs unmodified."""
+        acts = faults.on_step(epoch, step)
+        if faults.NAN_LOSS in acts:
+            if self.cfg.nan_guard:
+                raise TrainingDivergedError(
+                    f"non-finite loss nan at epoch {epoch} step {step} "
+                    f"(lr={lr}) [fault-injected]; restore from ckpt_dir to "
+                    f"recover"
+                )
+            rank0_print(
+                f"[faults] nan_loss injected at epoch {epoch} step {step} "
+                "but --no_nan_guard is set — ignored"
+            )
+
+    def _quarantine_ckpt(self, path: str, err: Exception) -> None:
+        """Rank-0 renames a failed checkpoint to ``*.corrupt`` (kept for
+        forensics, invisible to every discovery function). Other processes
+        only log — they will stop seeing the file once the rename lands."""
+        if jax.process_index() == 0:
+            try:
+                dst = ckpt_lib.quarantine(path)
+            except OSError:
+                dst = path + ".corrupt (rename failed)"
+        else:
+            dst = path + ".corrupt"
+        rank0_print(
+            f"WARNING: checkpoint {path} failed integrity verification "
+            f"({err}) — quarantined to {dst}; falling back to the next "
+            "older checkpoint"
+        )
+
+    def _check_ladder_agreement(self, picked_epoch: int) -> None:
+        """Multi-process resumes must agree on WHICH checkpoint the ladder
+        picked: the walk runs per-process (reads and transient errors are
+        local), and resuming different epochs on different processes is
+        silent divergence — the one unacceptable outcome. Every process
+        reaches this exact point once per _restore_latest (picked_epoch is
+        -1 when nothing usable was found), so the allgather is safe."""
+        if jax.process_count() <= 1:
+            return
+        from jax.experimental import multihost_utils  # noqa: PLC0415
+
+        got = np.asarray(
+            multihost_utils.process_allgather(np.int32(picked_epoch))
+        ).ravel()
+        if int(got.min()) != int(got.max()):
+            raise RuntimeError(
+                "processes disagree on the resume checkpoint (per-process "
+                f"ladder picks: {sorted(set(int(x) for x in got))}) — a "
+                "transient read error or racing quarantine made the "
+                "newest-intact walk diverge; inspect ckpt_dir (quarantined "
+                "*.corrupt files) and relaunch"
+            )
+
     def _restore_latest(self):
-        """Restore the newest checkpoint in the configured format.
-        Returns its epoch, or None when the dir holds nothing; raises when
-        the dir holds only the OTHER format (a silent restart-from-scratch
-        is the one unacceptable outcome)."""
+        """Restore the newest INTACT checkpoint in the configured format.
+
+        The retry ladder: walk newest→oldest; a candidate that is
+        unreadable or fails its CRC32 stamps (``--ckpt_verify``, default
+        on) is quarantined to ``*.corrupt`` with a rank-0 warning and the
+        next older checkpoint is tried — a torn/bit-flipped newest file
+        degrades the resume by one snapshot instead of bricking it.
+        Config mismatches (pipeline layout, AdamW mask, mid-epoch
+        batch/seed stamps, shape mismatches) still RAISE: those are
+        operator errors, not corruption, and falling past them would
+        silently resume the wrong run.
+
+        Returns the restored epoch, or None when the dir holds nothing
+        usable; raises when the dir holds only the OTHER format (a silent
+        restart-from-scratch is the one unacceptable outcome)."""
         cfg = self.cfg
         if not cfg.ckpt_dir:
             return None
         if cfg.sharded_ckpt:
-            find, read_meta_, restore_ = (
-                ckpt_lib.latest_sharded_checkpoint,
+            list_, read_meta_, restore_ = (
+                ckpt_lib.all_sharded_checkpoints,
                 ckpt_lib.read_sharded_meta,
                 ckpt_lib.restore_sharded,
             )
+            # multi-process: deep (full-CRC) verification would have EVERY
+            # process decompress the WHOLE checkpoint — n× the bytes the
+            # sharded format exists to avoid. Shallow verify checks the
+            # manifest/shard-set/zip directories; restore's own overlap
+            # reads still surface piece-level corruption to the ladder.
+            verify_ = functools.partial(
+                ckpt_lib.verify_sharded, deep=jax.process_count() == 1
+            )
             other = ckpt_lib.latest_checkpoint
         else:
-            find, read_meta_, restore_ = (
-                ckpt_lib.latest_checkpoint,
+            # plain format: verification is FUSED into restore's single
+            # decompression pass (verify=True) — a standalone verify_npz
+            # here would read the whole archive twice per resume
+            list_, read_meta_, restore_, verify_ = (
+                ckpt_lib.all_checkpoints,
                 ckpt_lib.read_meta,
-                ckpt_lib.restore,
+                functools.partial(ckpt_lib.restore, verify=cfg.ckpt_verify),
+                None,
             )
             other = ckpt_lib.latest_sharded_checkpoint
-        found = find(cfg.ckpt_dir)
-        if not found:
+        if jax.process_index() == 0:
+            # a crash between open(tmp) and the atomic rename leaks a *.tmp
+            # forever (plain npz, shard piece, or manifest alike); resume
+            # startup is a no-write-in-flight point, so sweep here
+            # (single-writer-per-file discipline)
+            ckpt_lib.sweep_stale_tmp(cfg.ckpt_dir)
+        candidates = list_(cfg.ckpt_dir)
+        if not candidates:
             if other(cfg.ckpt_dir):
                 raise ValueError(
                     f"ckpt_dir {cfg.ckpt_dir} holds checkpoints in the "
@@ -1145,11 +1282,37 @@ class Trainer:
                     "flip --sharded_ckpt to match (the formats do not "
                     "auto-convert)"
                 )
+            self._check_ladder_agreement(-1)
             return None
-        path, epoch = found
-        meta = read_meta_(path)
-        self._check_ckpt_meta(meta, path)
-        restored = restore_(path, self.state)
+        chosen = None
+        for path, epoch in candidates:
+            try:
+                if cfg.ckpt_verify and verify_ is not None:
+                    verify_(path)
+                meta = read_meta_(path)
+            except (ckpt_lib.CheckpointCorruptError,) + ckpt_lib.CKPT_READ_ERRORS as e:
+                self._quarantine_ckpt(path, e)
+                continue
+            # config-mismatch checks on the (readable) meta: a valid-but-
+            # wrong checkpoint must raise, not be quarantined as corrupt
+            self._check_ckpt_meta(meta, path)
+            try:
+                restored = restore_(path, self.state)
+            except (ckpt_lib.CheckpointCorruptError,) + ckpt_lib.CKPT_READ_ERRORS as e:
+                # plain format verifies CRCs HERE (fused into restore's
+                # read); sharded piece-level corruption also lands here
+                self._quarantine_ckpt(path, e)
+                continue
+            chosen = (path, epoch, meta, restored)
+            break
+        self._check_ladder_agreement(chosen[1] if chosen is not None else -1)
+        if chosen is None:
+            rank0_print(
+                f"WARNING: every checkpoint in {cfg.ckpt_dir} was corrupt "
+                "and has been quarantined — starting from scratch"
+            )
+            return None
+        path, epoch, meta, restored = chosen
         self.state = self._place_state(restored)
         # pick the recovery backoff up from the checkpoint (see _ckpt_meta)
         self._lr_scale = float(meta.get("lr_scale", 1.0))
@@ -1221,6 +1384,11 @@ class Trainer:
             self._tb = SummaryWriter(cfg.tensorboard_dir)
         attempts = cfg.auto_recover
         self._best_top1 = -1.0  # survives recovery retries of _fit_loop
+        # preemption-graceful shutdown: SIGTERM sets a flag; the loops honor
+        # it at the step/epoch grain and raise PreemptedError (restored to
+        # the previous disposition on every exit path below)
+        sig_token = preemption.install()
+        preemption.clear()
         try:
             while True:
                 try:
@@ -1239,19 +1407,25 @@ class Trainer:
                         "auto_recover", epoch=self._last_epoch,
                         lr_scale=self._lr_scale,
                     )
-        except KeyboardInterrupt:
+        except (KeyboardInterrupt, PreemptedError):
+            # Ctrl-C and SIGTERM share one snapshot discipline; the caller
+            # (cli/train.py) maps PreemptedError to the distinct
+            # PREEMPTION_EXIT_CODE so the launcher/orchestrator can requeue
             self._emergency_save()
             raise
         finally:
             # error exits (divergence, interrupt): still drain in-flight
             # writes, but log writer failures rather than mask the
             # propagating exception
+            preemption.restore(sig_token)
             self._ckpt_close(suppress=True)
             if self._tb is not None:
                 self._tb.close()
 
     def _emergency_save(self) -> None:
-        """Ctrl-C snapshot discipline.
+        """Ctrl-C / SIGTERM snapshot discipline (one path for both: the
+        preemption handler raises PreemptedError at the step grain, so by
+        the time this runs the in-flight step is finished and published).
 
         The ONLY source of truth is ``self._progress = (state, epoch,
         steps_done, epoch_complete)`` — published atomically at every
@@ -1444,6 +1618,13 @@ class Trainer:
                 self._ckpt_io().save(
                     cfg.ckpt_dir, self.state, epoch, cfg.keep_last_ckpts,
                     extra_meta=self._ckpt_meta(),
+                )
+            if preemption.requested():
+                # SIGTERM during eval/save lands here: the epoch is complete
+                # and published — the emergency path keeps/writes ckpt_epoch
+                raise PreemptedError(
+                    f"SIGTERM observed after epoch {epoch} completed — "
+                    f"shutting down at the epoch boundary"
                 )
         if cfg.ckpt_dir:
             self._ckpt_io().save(
