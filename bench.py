@@ -254,18 +254,26 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None,
 
     import contextlib
 
-    from tpu_dist.metrics.profiler import trace
+    from tpu_dist.metrics.profiler import StepTimer, trace
 
     prof = trace(profile_dir) if profile_dir else contextlib.nullcontext()
     with prof:
+        # per-step laps WITHOUT a per-step sync (StepTimer discipline): the
+        # device queue's backpressure paces the enqueues at the real step
+        # rate in steady state, so the percentiles see stalls/jitter while
+        # the hot loop stays sync-free; only the final block is exact.
+        timer = StepTimer(warmup_steps=1)
+        timer.tick()  # baseline mark (the warmup loop above already ran)
         t0 = time.perf_counter()
         for _ in range(steps):
             state, metrics = call(state, images, labels, 0.1)
+            timer.tick()
         jax.block_until_ready(state.params)
         dt = time.perf_counter() - t0
 
     img_per_sec = batch * steps / dt
     tag = "" if grad_compression == "none" else f"_{grad_compression}"
+    pct = timer.percentiles() or {}
     out = {
         "metric": f"{cfg.name}{tag}_train_throughput",
         "value": round(img_per_sec, 1),
@@ -276,6 +284,11 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None,
         "global_batch": batch,
         "img_per_sec_per_chip": round(img_per_sec / n_dev, 1),
         "step_ms": round(1000 * dt / steps, 2),
+        # tail latency in the same schema the trainer's epoch summary and
+        # `tpu_dist.obs summarize` report (p50/p95/p99), bench's ms units
+        **{
+            f"step_ms_{q}": round(1000 * v, 2) for q, v in sorted(pct.items())
+        },
         "mfu": _mfu(flops_per_step, dt / steps, n_dev),
     }
     if grad_compression != "none":

@@ -83,13 +83,15 @@ def test_td001_host_code_not_flagged():
 
 
 def test_td002_unguarded_print():
+    # an unguarded bare print is BOTH violations: every process duplicates
+    # it (TD002) and it bypasses the logging layer (TD007)
     vs = _lint(
         """
         def log_epoch(loss):
             print(f"loss {loss}")
         """
     )
-    assert _rules(vs) == ["TD002"]
+    assert _rules(vs) == ["TD002", "TD007"]
 
 
 def test_td002_guard_spellings_pass():
@@ -119,7 +121,10 @@ def test_td002_guard_spellings_pass():
                 f.write(rec)
         """
     )
-    assert vs == []
+    # every guard spelling satisfies TD002; the guarded PRINTS still carry
+    # TD007 (the bare-print rule is guard-agnostic — route through
+    # rank0_print), while the guarded file write carries nothing
+    assert _rules(vs) == ["TD007", "TD007", "TD007"]
 
 
 def test_td002_file_write_and_logger():
@@ -135,6 +140,29 @@ def test_td002_file_write_and_logger():
         """
     )
     assert sorted(_rules(vs)) == ["TD002", "TD002", "TD002"]
+
+
+# -- TD007: bare print outside the logging layer ----------------------------
+
+
+def test_td007_allowlist_paths():
+    # the logging layer itself may print (it IS the sink)...
+    vs = _lint("def f(x):\n    print(x)\n", "tpu_dist/metrics/logging.py")
+    assert "TD007" not in _rules(vs)  # (TD002 still applies there)
+    # ...as may the CLI report modules, exempt from both rules
+    vs = _lint("def f(x):\n    print(x)\n", "tpu_dist/obs/__main__.py")
+    assert _rules(vs) == []
+    # everywhere else the print is flagged even under a rank-0 guard
+    vs = _lint(
+        """
+        import jax
+
+        def f(x):
+            if jax.process_index() == 0:
+                print(x)
+        """
+    )
+    assert _rules(vs) == ["TD007"]
 
 
 # -- TD003: hot-path jit without donation ----------------------------------
@@ -301,18 +329,18 @@ def test_inline_and_block_suppressions():
     vs = _lint(
         """
         def a(loss):
-            print(loss)  # tpu-dist: ignore[TD002]
+            print(loss)  # tpu-dist: ignore[TD002,TD007]
 
         def b(loss):
-            # tpu-dist: ignore[TD002] — multi-line explanation of why this
-            # print is deliberate on every process
+            # tpu-dist: ignore[TD002, TD007] — multi-line explanation of why
+            # this print is deliberate on every process
             print(loss)
 
         def c(loss):
             print(loss)  # tpu-dist: ignore[TD001]  (wrong rule: still flagged)
         """
     )
-    assert _rules(vs) == ["TD002"]
+    assert _rules(vs) == ["TD002", "TD007"]
     assert vs[0].line == 11
 
 
@@ -323,9 +351,10 @@ def test_baseline_filters_and_reports_stale():
             print(loss)
         """
     )
-    assert _rules(vs) == ["TD002"]
+    assert _rules(vs) == ["TD002", "TD007"]
     entries = [
         {"rule": "TD002", "path": "tpu_dist/fake/mod.py", "snippet": "print(loss)"},
+        {"rule": "TD007", "path": "tpu_dist/fake/mod.py", "snippet": "print(loss)"},
         {"rule": "TD002", "path": "tpu_dist/fake/mod.py", "snippet": "print(gone)"},
     ]
     new, stale = baseline_lib.apply(vs, entries)
@@ -464,7 +493,7 @@ def test_cli_nonzero_on_planted_violation(tmp_path):
     r = _run_cli([str(bad), "--no-jaxpr", "--format", "json"])
     assert r.returncode == 1, r.stdout + r.stderr
     out = json.loads(r.stdout)
-    assert {v["rule"] for v in out["violations"]} == {"TD002", "TD004"}
+    assert {v["rule"] for v in out["violations"]} == {"TD002", "TD004", "TD007"}
 
 
 @pytest.mark.quick

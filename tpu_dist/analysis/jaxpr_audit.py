@@ -551,12 +551,82 @@ def fault_noop_violations(mesh=None) -> list[Violation]:
     return []
 
 
+def telemetry_noop_violations(mesh=None) -> list[Violation]:
+    """TD106: the run-telemetry subsystem's zero-cost contract, checked at
+    the program level (the TD105 pattern applied to ``tpu_dist.obs``) —
+    trace the data-parallel step with telemetry disarmed, then again with
+    the full kit armed (span recorder enabled, counters live and moving, a
+    heartbeat beating), and require the two jaxprs to be byte-identical.
+    Spans/counters/heartbeat are host-side by construction; the moment an
+    instrumentation point leaks a traced op (a timing ``device_get``, a
+    counter fed from a tracer), this trips."""
+    import os
+    import tempfile
+
+    import jax
+
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.obs import counters, spans
+    from tpu_dist.obs.heartbeat import Heartbeat
+
+    m = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+    was_enabled = spans.enabled()
+    spans.disable()
+    hb_path = None
+    try:
+        fn, args = _dp_setup(m)
+        base = str(jax.make_jaxpr(fn)(*args))
+        # arm everything the trainer would arm. fresh=False when a live
+        # recorder was already armed: the audit must not wipe its
+        # undrained buffer or shift its clock origin. The probe counter
+        # and heartbeat beats are honest process telemetry (they record
+        # that an audit ran), not pollution to scrub.
+        spans.enable(fresh=not was_enabled)
+        counters.inc("analysis.td106_probes")
+        fd, hb_path = tempfile.mkstemp(suffix=".heartbeat.json")
+        os.close(fd)
+        hb = Heartbeat(hb_path)
+        hb.beat(epoch=0, step=0, force=True)
+        with spans.span("td106/trace_probe"):
+            fn2, args2 = _dp_setup(m)
+            armed = str(jax.make_jaxpr(fn2)(*args2))
+        hb.sweep()
+    finally:
+        if was_enabled:
+            # re-arm even when the trace raised BEFORE the enable above —
+            # the caller's live recorder must not come back disabled
+            # (idempotent when the enable did run)
+            spans.enable(fresh=False)
+        else:
+            spans.disable()
+            spans.drain()  # discard the probe's own span events
+        if hb_path is not None:
+            try:
+                os.remove(hb_path)
+            except FileNotFoundError:
+                pass
+    if base != armed:
+        return [
+            Violation(
+                "TD106",
+                "<jaxpr:dp_telemetry_noop>",
+                0,
+                "the traced train step CHANGED when run telemetry was "
+                "armed — an instrumentation point leaked into the compiled "
+                "program; spans/counters/heartbeat must stay host-side "
+                "(tpu_dist.obs contract, docs/observability.md)",
+                snippet="jaxpr(telemetry_off) != jaxpr(telemetry_armed)",
+            )
+        ]
+    return []
+
+
 def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     """Run every (or the named) registered case. Returns
     ``(report, violations)`` where report maps case → op counts.
     Cross-case TD104 wire-ratio checks run over whichever quantized/
     reference pairs the report contains; full (unfiltered) runs also check
-    the TD105 fault-injection no-op invariant."""
+    the TD105 fault-injection and TD106 telemetry no-op invariants."""
     report: dict = {}
     violations: list[Violation] = []
     for name in names if names is not None else registered_cases():
@@ -567,6 +637,9 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     if names is None:
         vs = fault_noop_violations(mesh)
         report["dp_faults_noop"] = {"identical": not vs}
+        violations.extend(vs)
+        vs = telemetry_noop_violations(mesh)
+        report["dp_telemetry_noop"] = {"identical": not vs}
         violations.extend(vs)
     return report, violations
 

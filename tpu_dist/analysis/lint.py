@@ -41,6 +41,7 @@ from tpu_dist.analysis.rules import (
     RANK_VAR_NAMES,
     TD002_EXEMPT_PARTS,
     TD006_ALLOWED_SILENT,
+    TD007_ALLOWED_PARTS,
     TRACE_ENTRY_CALLS,
     Violation,
 )
@@ -356,6 +357,7 @@ class _FileLint:
         for fn in self.traced:
             self._check_traced_body(fn, emit)
         self._check_io(emit)
+        self._check_bare_print(emit)
         self._check_jit_donate(emit)
         self._check_silent_except(emit)
         return out
@@ -474,6 +476,27 @@ class _FileLint:
                 if any(t in basename.lower() for t in LOGGERISH_NAMES):
                     return f"{basename}.{func.attr}()"
         return None
+
+    def _check_bare_print(self, emit) -> None:  # TD007
+        """Stricter sibling of TD002: ANY bare ``print(`` outside the
+        designated logging layer — a rank-0 guard makes it correct but
+        still un-grep-able and un-silenceable; the discipline is one
+        output layer (rank0_print/get_logger)."""
+        if any(part in self.rel_path for part in TD007_ALLOWED_PARTS):
+            return
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                emit(
+                    "TD007",
+                    node,
+                    "bare print() bypasses the logging layer; use "
+                    "rank0_print/get_logger (tpu_dist.metrics.logging) — or "
+                    "inline-ignore with the reason this sink is deliberate",
+                )
 
     def _exc_type_names(self, t: ast.AST) -> list[str]:
         """Dotted names of the handled exception type(s); '<dynamic>' for

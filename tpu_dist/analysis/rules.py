@@ -78,6 +78,14 @@ RULES: dict[str, Rule] = {
             "re-raise, log, or narrow the type",
         ),
         Rule(
+            "TD007",
+            "bare-print-outside-logging-layer",
+            "bare `print(` outside the metrics/logging allowlist — even "
+            "rank-0-guarded prints bypass the one grep-able output layer "
+            "(rank0_print / get_logger / ProgressMeter); route through it "
+            "or inline-ignore with the audit reason",
+        ),
+        Rule(
             "TD101",
             "collective-budget-mismatch",
             "jaxpr collective count differs from the parallelism config's "
@@ -104,6 +112,14 @@ RULES: dict[str, Rule] = {
             "an armed --fault_plan — injection points must be host-side "
             "no-ops that never enter the compiled program "
             "(resilience/faults.py contract)",
+        ),
+        Rule(
+            "TD106",
+            "telemetry-not-noop",
+            "the traced train step differs between telemetry OFF and "
+            "armed spans/counters/heartbeat — run telemetry must be "
+            "host-side only and add no per-step device work "
+            "(tpu_dist.obs contract, docs/observability.md)",
         ),
         Rule(
             "TD104",
@@ -194,8 +210,20 @@ RANK_CALL_SUFFIXES = ("process_index", "is_primary", "get_rank")
 RANK_VAR_NAMES = {"rank", "local_rank", "process_id", "proc_id", "process_index", "pid"}
 
 # Modules exempt from TD002: host-side tooling that never runs inside a
-# multi-process training job (the analysis CLI's own report output).
-TD002_EXEMPT_PARTS = ("tpu_dist/analysis/",)
+# multi-process training job (the analysis and obs CLIs' report output).
+TD002_EXEMPT_PARTS = ("tpu_dist/analysis/", "tpu_dist/obs/__main__.py")
+
+# TD007 allowlist: the designated output layer (rank0_print/get_logger and
+# the ProgressMeter display sink, which carries the rank-0 guard itself)
+# plus pure-CLI report modules whose stdout IS the product. Everything
+# else must route prints through the logging layer — the statically-
+# enforced version of the rank-0 discipline the reference only documents.
+TD007_ALLOWED_PARTS = (
+    "tpu_dist/metrics/logging.py",
+    "tpu_dist/metrics/meters.py",
+    "tpu_dist/analysis/",
+    "tpu_dist/obs/__main__.py",
+)
 
 # TD003 scope: jit calls inside these factory-name patterns are "hot path".
 HOT_FACTORY_REGEX = r"^(make|build)_.*(step|epoch|train|update)"

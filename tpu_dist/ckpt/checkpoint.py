@@ -23,6 +23,7 @@ from typing import Any, List, Optional, Tuple
 import jax
 import numpy as np
 
+from tpu_dist.obs import counters, spans
 from tpu_dist.resilience import faults
 from tpu_dist.resilience import retry as retry_lib
 from tpu_dist.train.state import TrainState
@@ -153,20 +154,30 @@ def _write_npz(
             np.savez(f, **flat)
         os.replace(tmp, path)  # atomic: a ckpt is either absent or complete
 
-    retry_lib.retry_call(attempt, retries=_IO_RETRIES, describe=f"write of {name}")
+    with spans.span("ckpt/write", file=name):
+        retry_lib.retry_call(
+            attempt, retries=_IO_RETRIES, describe=f"write of {name}"
+        )
+    counters.inc("ckpt.writes")
+    try:
+        counters.inc("ckpt.bytes_written", os.path.getsize(path))
+    except OSError:  # tpu-dist: ignore[TD006] — telemetry only: a racing
+        pass  # prune/corruption-injection must not fail the publish
     faults.on_ckpt_published(path)  # --fault_plan ckpt_corrupt hook (no-op off)
     if keep_last is not None and keep_last > 0:
-        sweep_stale_tmp(ckpt_dir)  # crash-leaked *.tmp never accumulates
-        epochs = sorted(
-            int(m.group(1))
-            for m in (_CKPT_RE.search(n) for n in os.listdir(ckpt_dir))
-            if m
-        )
-        for e in epochs[:-keep_last]:
-            try:
-                os.remove(os.path.join(ckpt_dir, f"ckpt_{e}.npz"))
-            except OSError:  # tpu-dist: ignore[TD006] — prune is best-effort:
-                pass  # a file already gone (or unlinkable) must not fail a save
+        with spans.span("ckpt/prune", keep_last=keep_last):
+            sweep_stale_tmp(ckpt_dir)  # crash-leaked *.tmp never accumulates
+            epochs = sorted(
+                int(m.group(1))
+                for m in (_CKPT_RE.search(n) for n in os.listdir(ckpt_dir))
+                if m
+            )
+            for e in epochs[:-keep_last]:
+                try:
+                    os.remove(os.path.join(ckpt_dir, f"ckpt_{e}.npz"))
+                    counters.inc("ckpt.pruned")
+                except OSError:  # tpu-dist: ignore[TD006] — prune is best-effort:
+                    pass  # a file already gone (or unlinkable) must not fail a save
     return path
 
 
@@ -363,6 +374,7 @@ def quarantine(path: str) -> str:
         dst = f"{path}.corrupt.{i}"
         i += 1
     os.replace(path, dst)
+    counters.inc("ckpt.quarantines")
     return dst
 
 
@@ -425,7 +437,7 @@ def restore(path: str, template: TrainState, verify: bool = False) -> TrainState
     :func:`verify_npz` in the single decompression pass the restore does
     anyway (a separate verify-then-restore would read the archive twice).
     """
-    with np.load(path) as z:
+    with spans.span("ckpt/restore", file=os.path.basename(path)), np.load(path) as z:
         crcs = None
         if verify:
             meta = {}
@@ -584,7 +596,17 @@ def save_sharded(
             np.savez(f, **shard_flat)
         os.replace(tmp, os.path.join(ckpt_dir, name))
 
-    retry_lib.retry_call(write_shard, retries=_IO_RETRIES, describe=f"write of {name}")
+    with spans.span("ckpt/write_shard", file=name):
+        retry_lib.retry_call(
+            write_shard, retries=_IO_RETRIES, describe=f"write of {name}"
+        )
+    counters.inc("ckpt.writes")
+    try:
+        counters.inc(
+            "ckpt.bytes_written", os.path.getsize(os.path.join(ckpt_dir, name))
+        )
+    except OSError:  # tpu-dist: ignore[TD006] — telemetry only (see _write_npz)
+        pass
 
     # the manifest is the commit marker: all shard files must exist first
     if nproc > 1:
@@ -607,9 +629,11 @@ def save_sharded(
             json.dump(manifest, f)
         os.replace(tmp, mpath)
 
-    retry_lib.retry_call(
-        write_manifest, retries=_IO_RETRIES, describe=f"commit of {stem}"
-    )
+    with spans.span("ckpt/write_manifest", file=os.path.basename(mpath)):
+        retry_lib.retry_call(
+            write_manifest, retries=_IO_RETRIES, describe=f"commit of {stem}"
+        )
+    counters.inc("ckpt.writes")
     faults.on_ckpt_published(mpath)
     if keep_last is not None and keep_last > 0:
         sweep_stale_tmp(ckpt_dir)  # post-commit barrier: no write in flight
@@ -745,6 +769,8 @@ def restore_sharded(manifest_path: str, template: TrainState) -> TrainState:
     Overlap-only reads: each process decompresses just the pieces that
     intersect its own target shards, so restore memory scales with the
     local partition, not the global model (see the section header)."""
+    # (span: the trainer's restore ladder wraps this whole call — a local
+    # span here would cover only the manifest read)
     with open(manifest_path) as f:
         manifest = json.load(f)
     ckpt_dir = os.path.dirname(manifest_path)

@@ -216,8 +216,9 @@ def acquire(
             if remaining > 0:
                 if not announced:
                     pid, own = _read_holder(fd)
-                    # tpu-dist: ignore[TD002] — lock-contention diagnostic:
-                    # each contending process must report its own wait state
+                    # tpu-dist: ignore[TD002,TD007] — lock-contention
+                    # diagnostic: each contending process must report its
+                    # own wait state (deliberately NOT the rank-0 layer)
                     print(
                         f"{owner}: TPU lock {path} held by pid {pid} "
                         f"(owner: {own}); waiting up to {wait_s:.0f}s for "
@@ -266,7 +267,8 @@ def guard_or_exit(
     try:
         return acquire(owner, wait_s=wait_s)
     except TPULockError as e:
-        # tpu-dist: ignore[TD002] — CLI-entrypoint failure path: the holder
-        # message must reach the operator from whichever process hit it
+        # tpu-dist: ignore[TD002,TD007] — CLI-entrypoint failure path: the
+        # holder message must reach the operator from whichever process hit
+        # it (deliberately NOT the rank-0 layer)
         print(f"{owner}: {e}", file=sys.stderr, flush=True)
         raise SystemExit(exit_code)

@@ -93,6 +93,22 @@ class TrainConfig:
                                    # utils/config.py:8 knob, made real
                                    # (metrics/tensorboard.py, rank 0)
 
+    # -- run telemetry (docs/observability.md) ------------------------------
+    trace_file: Optional[str] = None  # Chrome trace-event JSON of host
+                                   # spans (ckpt/loader/eval/dispatch),
+                                   # Perfetto-loadable; rank 0. Spans are
+                                   # also armed when log_file is set (they
+                                   # ride the JSONL as 'spans' records)
+    heartbeat_file: Optional[str] = None  # rank-0 liveness file updated at
+                                   # the step grain (monotonic counter +
+                                   # epoch/step); swept on clean exit —
+                                   # external watchdogs distinguish a hung
+                                   # step from a slow one
+    straggler_threshold: float = 1.5  # epoch-end max/median skew of the
+                                   # allgathered per-process epoch times
+                                   # above which a rank-0 straggler warning
+                                   # (+ history record) fires; 0 disables
+
     # -- TPU fast path -------------------------------------------------------
     fused_epoch: bool = False      # device-resident data, one jit per epoch
                                    # (docs in train/epoch.py; small datasets)
@@ -303,6 +319,21 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="TensorBoard event-file dir (self-contained writer, "
                         "no TF dependency; the reference's utils/config.py:8 "
                         "knob made functional)")
+    p.add_argument("--trace_file", type=str, default=None,
+                   help="write host-span Chrome trace-event JSON here at "
+                        "the end of the run (Perfetto / chrome://tracing "
+                        "loadable; rank 0 — docs/observability.md)")
+    p.add_argument("--heartbeat_file", type=str, default=None,
+                   help="rank-0 liveness file rewritten at the step grain "
+                        "(monotonic beat counter + epoch/step position), "
+                        "swept on clean exit — lets an external watchdog "
+                        "tell a hung step from a slow one")
+    p.add_argument("--straggler_threshold", type=float,
+                   default=d.straggler_threshold, metavar="X",
+                   help="warn (rank 0) + log a history record when the "
+                        "slowest process's epoch time exceeds X times the "
+                        "median across processes (allgathered at epoch "
+                        "end); 0 disables")
     p.add_argument("--eval_every", type=int, default=d.eval_every,
                    help="epochs between evaluations; 0 disables")
     p.add_argument("--save_every", type=int, default=d.save_every)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterator, Optional, Tuple
 
 import numpy as np
@@ -26,6 +27,7 @@ from jax.sharding import Mesh
 
 from tpu_dist.comm import mesh as mesh_lib
 from tpu_dist.data.sampler import DistributedSampler
+from tpu_dist.obs import counters, spans
 from tpu_dist.resilience import faults
 
 
@@ -163,16 +165,26 @@ class DataLoader:
                         # below must notice, not hang)
                         killed.append(b)
                         return
-                    batch = mesh_lib.shard_batch(self.mesh, hb, self.shard_axes)
+                    # telemetry: the producer THREAD writes the registry —
+                    # counters are locked for exactly this
+                    with spans.span("loader/produce", batch=b):
+                        batch = mesh_lib.shard_batch(self.mesh, hb, self.shard_axes)
+                    counters.inc("loader.batches_produced")
                     # bounded put that notices consumer abandonment (e.g. the
                     # trainer's steps_per_epoch early break) instead of
                     # blocking forever and leaking the thread + device batches
+                    t_put = time.perf_counter()
                     while not stop.is_set():
                         try:
                             q.put(batch, timeout=0.1)
                             break
                         except queue.Full:
                             continue
+                    # time the producer spent blocked on a FULL queue: the
+                    # loader outrunning the device (the healthy direction)
+                    counters.add_seconds(
+                        "loader.producer_wait_s", time.perf_counter() - t_put
+                    )
                     if stop.is_set():
                         return
             except Exception as e:  # surfaced on the consumer side
@@ -185,9 +197,15 @@ class DataLoader:
         t.start()
         try:
             while True:
+                t_wait = time.perf_counter()
                 try:
                     item = q.get(timeout=self.watchdog_timeout)
                 except queue.Empty:
+                    # polling ticks count as consumer wait too — a slow
+                    # producer is exactly what this counter measures
+                    counters.add_seconds(
+                        "loader.data_wait_s", time.perf_counter() - t_wait
+                    )
                     # watchdog: only a DEAD producer with a drained queue is
                     # a failure — nothing can arrive anymore (a live-but-slow
                     # producer just keeps us polling)
@@ -201,8 +219,12 @@ class DataLoader:
                             "instead of waiting on q.get() forever"
                         )
                     continue
+                counters.add_seconds(
+                    "loader.data_wait_s", time.perf_counter() - t_wait
+                )
                 if item is None:
                     break
+                counters.inc("loader.batches_consumed")
                 yield item
         finally:
             stop.set()

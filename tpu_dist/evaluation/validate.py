@@ -23,6 +23,7 @@ import jax
 
 from tpu_dist.metrics.meters import AverageMeter, ProgressMeter
 from tpu_dist.metrics.logging import rank0_print
+from tpu_dist.obs import counters, spans
 
 
 def validate(loader, state, eval_step: Callable, *, log_every: int = 50, epoch: Optional[int] = None):
@@ -41,6 +42,7 @@ def validate(loader, state, eval_step: Callable, *, log_every: int = 50, epoch: 
     )
 
     tot = {"loss": 0.0, "top1": 0.0, "top5": 0.0, "count": 0.0}
+    t_eval = time.perf_counter()
     end = time.time()
     for i, (images, labels, mask) in enumerate(loader):
         sums = eval_step(state, images, labels, mask)
@@ -60,5 +62,10 @@ def validate(loader, state, eval_step: Callable, *, log_every: int = 50, epoch: 
 
     n = max(tot["count"], 1.0)
     t1, t5, loss = tot["top1"] / n * 100.0, tot["top5"] / n * 100.0, tot["loss"] / n
+    # telemetry (host-side): one span for the whole distributed eval pass
+    spans.add_event(
+        "eval/validate", t_eval, time.perf_counter() - t_eval, epoch=epoch
+    )
+    counters.inc("eval.runs")
     rank0_print(f" * Acc@1 {t1:.3f} Acc@5 {t5:.3f}" + (f" (epoch {epoch})" if epoch is not None else ""))
     return t1, t5, loss

@@ -1,7 +1,25 @@
 """JSONL metrics history — file-based observability the reference reserves
 but never builds (``.gitignore:3`` ignores ``/log``; tensorboard knob dead
 in ``utils/config.py:8``). One JSON object per line, append-only, rank-0
-only; consumable by pandas/jq/tensorboard-importers alike.
+only; consumable by pandas/jq/tensorboard-importers and by
+``python -m tpu_dist.obs summarize`` (docs/observability.md).
+
+Schema (version 2): every record carries
+
+* ``ts`` — wall clock (epoch seconds; for humans and cross-run joins),
+* ``rel_s`` — monotonic seconds since this history opened (immune to NTP
+  steps; what offline latency math should use),
+* ``schema_version`` and, when the owner passed one, ``run_id`` (config
+  hash + start time stamped ONCE at construction — not re-derived per
+  record, so every line of a run agrees),
+* ``kind`` plus the caller's fields,
+* ``counters`` — a snapshot of the process-global telemetry registry
+  (``tpu_dist.obs.counters``), when non-empty; the summarize CLI turns
+  successive snapshots into per-epoch deltas.
+
+The file handle is opened once, line-buffered, and reused — the previous
+open-per-``log()`` implementation paid a file open/close every record and
+could interleave badly with slow filesystems.
 """
 
 from __future__ import annotations
@@ -13,20 +31,67 @@ from typing import Optional
 
 import jax
 
+from tpu_dist.obs import counters as counters_lib
+
+SCHEMA_VERSION = 2
+
 
 class MetricsHistory:
-    def __init__(self, path: Optional[str]):
-        """``path=None`` disables (and any non-primary process is a no-op)."""
+    def __init__(
+        self,
+        path: Optional[str],
+        run_id: Optional[str] = None,
+        t0: Optional[float] = None,
+    ):
+        """``path=None`` disables (and any non-primary process is a no-op).
+        ``run_id`` identifies the run in every record; the Trainer passes
+        its config-hash + start-time stamp. ``t0`` (a ``time.monotonic()``
+        reading) overrides the ``rel_s`` origin — the Trainer passes its
+        construction instant, the SAME origin its span recorder zeroes at,
+        so exported epoch bars and host spans share one timeline."""
         self.path = path if (path and jax.process_index() == 0) else None
+        self.run_id = run_id
+        self._f = None
+        self._t0 = t0 if t0 is not None else time.monotonic()
         if self.path:
             os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+            # tpu-dist: ignore[TD002] — self.path is None off rank 0 (guard
+            # in __init__), so this handle only ever exists on the primary.
+            # buffering=1: line-buffered — each record is flushed whole, so
+            # tail -f / a concurrent summarize sees complete lines only.
+            self._f = open(self.path, "a", buffering=1)
 
     def log(self, kind: str, **fields) -> None:
-        if not self.path:
+        if self._f is None:
             return
-        rec = {"ts": round(time.time(), 3), "kind": kind}
+        rec = {
+            "ts": round(time.time(), 3),
+            "rel_s": round(time.monotonic() - self._t0, 3),
+            "schema_version": SCHEMA_VERSION,
+            "kind": kind,
+        }
+        if self.run_id:
+            rec["run_id"] = self.run_id
         rec.update({k: (float(v) if hasattr(v, "item") else v) for k, v in fields.items()})
-        # tpu-dist: ignore[TD002] — self.path is None off rank 0 (guard in
-        # __init__), so this append only ever runs on the primary process
-        with open(self.path, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+        if "counters" not in rec:
+            snap = counters_lib.snapshot()
+            if snap:
+                rec["counters"] = snap
+        self._f.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        if self._f is not None:
+            f, self._f = self._f, None
+            f.close()
+
+    def __enter__(self) -> "MetricsHistory":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # belt-and-braces: the Trainer close()s explicitly
+        try:
+            self.close()
+        except Exception:  # tpu-dist: ignore[TD006] — __del__ runs at
+            pass  # interpreter teardown where raising is forbidden anyway

@@ -46,20 +46,32 @@ def annotate_step(step: int):
 
 class StepTimer:
     """Steady-state throughput: skips warmup/compile steps, no per-step
-    device sync (the device queue keeps the TPU busy; only ``finish`` blocks)."""
+    device sync (the device queue keeps the TPU busy; only ``finish`` blocks).
+
+    Beyond the mean, each post-warmup ``tick`` records a per-step lap on
+    the monotonic clock, so the trainer's epoch summary can report tail
+    latency (:meth:`percentiles`) — the p99 is where input stalls and
+    stragglers live; a mean hides them completely."""
 
     def __init__(self, warmup_steps: int = 3):
         self.warmup_steps = warmup_steps
         self._seen = 0
         self._t0: Optional[float] = None
+        self._last: Optional[float] = None
         self.steps = 0
+        self.laps: list = []  # post-warmup per-step seconds, tick-to-tick
 
     def tick(self) -> None:
+        now = time.perf_counter()
         self._seen += 1
         if self._seen == self.warmup_steps:
-            self._t0 = time.perf_counter()
+            self._t0 = now
+            self._last = now
         elif self._seen > self.warmup_steps:
             self.steps += 1
+            if self._last is not None:
+                self.laps.append(now - self._last)
+            self._last = now
 
     def finish(self, blocker=None) -> Optional[float]:
         """Seconds per steady-state step (None if too few steps).
@@ -69,3 +81,16 @@ class StepTimer:
         if self._t0 is None or self.steps == 0:
             return None
         return (time.perf_counter() - self._t0) / self.steps
+
+    def percentiles(self, qs=(50, 95, 99)) -> Optional[dict]:
+        """``{"p50": s, "p95": s, "p99": s}`` over the recorded laps
+        (nearest-rank; None with no laps — e.g. a 1-step epoch where every
+        step was warmup)."""
+        if not self.laps:
+            return None
+        laps = sorted(self.laps)
+        n = len(laps)
+        return {
+            f"p{q}": laps[min(n - 1, max(0, int(round(q / 100.0 * n)) - 1))]
+            for q in qs
+        }

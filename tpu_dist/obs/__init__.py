@@ -1,0 +1,23 @@
+"""Run telemetry: span tracing, subsystem counters, heartbeat, straggler
+detection, and the offline ``python -m tpu_dist.obs summarize`` CLI.
+
+Contract (audited by TD106): everything in this package is host-side —
+arming telemetry leaves the traced train step byte-identical and adds no
+per-step device transfers. See ``docs/observability.md``.
+"""
+
+from tpu_dist.obs import counters, spans  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy: straggler/heartbeat pull in the (jax-importing) logging layer;
+    # the offline CLI and the loader producer thread only need counters/spans
+    if name == "Heartbeat":
+        from tpu_dist.obs.heartbeat import Heartbeat
+
+        return Heartbeat
+    if name == "epoch_skew":
+        from tpu_dist.obs.straggler import epoch_skew
+
+        return epoch_skew
+    raise AttributeError(f"module 'tpu_dist.obs' has no attribute {name!r}")

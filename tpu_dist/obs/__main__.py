@@ -1,0 +1,66 @@
+"""CLI: ``python -m tpu_dist.obs`` — offline run-telemetry reports.
+
+Subcommands::
+
+    summarize <run.jsonl> [--format text|json]
+        Per-epoch throughput, step-time p50/p95/p99, data-stall fraction,
+        counter deltas, straggler findings — from a ``--log_file`` JSONL.
+
+    export-trace <run.jsonl> [-o trace.json]
+        Chrome trace-event JSON (Perfetto / chrome://tracing loadable)
+        from the run's drained spans + synthesized epoch/eval bars.
+
+Exit codes: 0 ok, 1 empty/unusable input, 2 bad invocation or I/O error.
+The analysis itself is pure file crunching — no device, no backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpu_dist.obs import summarize as summ
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_dist.obs",
+        description="offline run-telemetry reports over a --log_file JSONL",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    s = sub.add_parser("summarize", help="per-epoch throughput/latency/counter report")
+    s.add_argument("log", help="JSONL history written by --log_file")
+    s.add_argument("--format", choices=("text", "json"), default="text")
+    t = sub.add_parser("export-trace", help="write Chrome trace-event JSON")
+    t.add_argument("log", help="JSONL history written by --log_file")
+    t.add_argument("-o", "--out", default=None, help="output path (default: <log>.trace.json)")
+    args = ap.parse_args(argv)
+
+    try:
+        records, bad = summ.load_records(args.log)
+    except OSError as e:
+        print(f"tpu_dist.obs: cannot read {args.log}: {e}", file=sys.stderr)
+        return 2
+    if not records:
+        print(f"tpu_dist.obs: no records in {args.log}", file=sys.stderr)
+        return 1
+
+    if args.cmd == "summarize":
+        report = summ.summarize(records, bad)
+        if args.format == "json":
+            print(json.dumps(report, indent=2))
+        else:
+            print(summ.format_text(report))
+        return 0
+
+    out_path = args.out or (args.log + ".trace.json")
+    trace = summ.export_trace(records)
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace['traceEvents'])} event(s) to {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

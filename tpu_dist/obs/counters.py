@@ -1,0 +1,101 @@
+"""Process-global counter/gauge registry — the numeric half of the run
+telemetry (``docs/observability.md``).
+
+Every subsystem that does host-side work increments named counters here:
+the checkpoint layer (writes / retried writes / quarantines / bytes), the
+data loader (batches produced/consumed, producer/consumer wait seconds —
+the producer THREAD writes too, hence the lock), the resilience layer
+(faults fired, preemptions observed), and the trainer (steps, epochs).
+:class:`~tpu_dist.metrics.history.MetricsHistory` snapshots the registry
+into every JSONL record, so ``python -m tpu_dist.obs summarize`` can report
+per-epoch counter deltas offline.
+
+Design constraints:
+
+* **No jax import** — the loader producer thread and the fault-injection
+  hooks run before/without a backend; this module is plain stdlib.
+* **Thread-safe** — one ``RLock`` around every mutation; values are
+  ints/floats (counters, monotonically increasing) or arbitrary
+  JSON-serializable scalars (gauges/info, last-write-wins).
+* **Zero hot-path device cost** — everything here is host arithmetic; the
+  TD106 audit proves the traced train step is byte-identical whether or
+  not telemetry is armed.
+
+Counters and gauges share one flat namespace (dotted names,
+``subsystem.metric``); :func:`snapshot` returns them merged. Counter names
+in use are catalogued in ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+# RLock, not Lock: a Python-level signal handler or a re-entrant caller on
+# the same thread must never deadlock against its own snapshot in flight.
+_LOCK = threading.RLock()
+_COUNTERS: Dict[str, float] = {}
+_GAUGES: Dict[str, object] = {}
+
+
+def inc(name: str, n: float = 1) -> float:
+    """Add ``n`` to counter ``name`` (created at 0); returns the new value.
+    Counters are monotonic by convention — use :func:`set_gauge` for values
+    that move both ways."""
+    with _LOCK:
+        v = _COUNTERS.get(name, 0) + n
+        _COUNTERS[name] = v
+        return v
+
+
+def add_seconds(name: str, seconds: float) -> float:
+    """Accumulate a duration counter (float seconds). Same as :func:`inc`;
+    named separately so call sites read as what they measure."""
+    return inc(name, float(seconds))
+
+
+def set_gauge(name: str, value: object) -> None:
+    """Last-write-wins gauge/info value (number or short string — must be
+    JSON-serializable; history records embed it verbatim)."""
+    with _LOCK:
+        _GAUGES[name] = value
+
+
+def get(name: str, default: float = 0) -> float:
+    with _LOCK:
+        return _COUNTERS.get(name, default)
+
+
+def snapshot() -> Dict[str, object]:
+    """One consistent flat copy of counters + gauges (counters win a name
+    collision — they are the monotonic, delta-able series)."""
+    with _LOCK:
+        out: Dict[str, object] = dict(_GAUGES)
+        out.update(_COUNTERS)
+        return out
+
+
+def reset() -> None:
+    """Clear everything — test isolation and the start of a fresh run."""
+    with _LOCK:
+        _COUNTERS.clear()
+        _GAUGES.clear()
+
+
+def delta(prev: Optional[Dict[str, object]], cur: Dict[str, object]) -> Dict[str, float]:
+    """Numeric difference ``cur - prev`` per key (offline analysis of two
+    history snapshots). Keys that are non-numeric in either snapshot
+    (gauges/info strings) and zero deltas are omitted; a key absent from
+    ``prev`` counts from 0."""
+    prev = prev or {}
+    out: Dict[str, float] = {}
+    for k, v in cur.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        p = prev.get(k, 0)
+        if isinstance(p, bool) or not isinstance(p, (int, float)):
+            continue
+        d = v - p
+        if d:
+            out[k] = round(d, 6) if isinstance(d, float) else d
+    return out

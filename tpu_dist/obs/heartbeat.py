@@ -1,0 +1,99 @@
+"""Rank-0 heartbeat file — liveness signal for external watchdogs
+(``docs/observability.md``).
+
+A pod orchestrator watching a training job from outside cannot tell a HUNG
+step (deadlocked collective, dead loader producer) from a SLOW one (big
+compile, cold cache) by looking at the process table — both look like a
+silent process. The heartbeat file answers it: rank 0 rewrites one small
+JSON file at the step grain with a strictly monotonic beat counter plus
+the (epoch, step) position; a watchdog that sees the counter stop
+advancing for N× the recent step time knows the job is wedged, not slow.
+
+Discipline:
+
+* **Atomic** — write-to-temp + ``os.replace``, so a reader never sees a
+  torn file (same discipline as the checkpoint writers).
+* **Throttled** — ``min_interval`` caps the write rate (default 1 s) so a
+  fast step loop costs at most one small write per interval; position
+  changes that MUST land (preemption observed, epoch boundaries, sweep)
+  pass ``force=True``.
+* **Swept on clean exit** — a leftover heartbeat means the process died;
+  its absence after exit is itself the "ended cleanly" signal.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from tpu_dist.obs import counters
+
+
+class Heartbeat:
+    """One writer per file (the trainer creates it on rank 0 only)."""
+
+    def __init__(self, path: str, min_interval: float = 1.0):
+        self.path = path
+        self.min_interval = min_interval
+        self.counter = 0
+        self._last_write = float("-inf")
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+
+    def beat(
+        self,
+        *,
+        epoch: Optional[int] = None,
+        step: Optional[int] = None,
+        phase: str = "train",
+        force: bool = False,
+    ) -> bool:
+        """Advance the beat counter; write the file unless inside the
+        throttle window (``force`` bypasses it). Returns True when the
+        file was (re)written. Never raises on I/O: a full/absent disk must
+        not kill the training step that beats."""
+        self.counter += 1
+        counters.inc("heartbeat.beats")
+        now = time.monotonic()
+        if not force and now - self._last_write < self.min_interval:
+            return False
+        self._last_write = now
+        payload = {
+            "counter": self.counter,
+            "epoch": epoch,
+            "step": step,
+            "phase": phase,
+            "ts": round(time.time(), 3),
+            "mono_s": round(now, 3),
+            "pid": os.getpid(),
+        }
+        tmp = self.path + ".tmp"
+        try:
+            # tpu-dist: ignore[TD002,TD007] — rank-0-only by construction
+            # (the trainer creates the Heartbeat on the primary process)
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            counters.inc("heartbeat.write_errors")
+            return False
+        return True
+
+    def sweep(self) -> None:
+        """Remove the file — clean-exit signal. Best-effort by design."""
+        for p in (self.path, self.path + ".tmp"):
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
+
+
+def read(path: str) -> Optional[dict]:
+    """Watchdog-side read; None when absent (clean exit or not started)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None

@@ -1,0 +1,200 @@
+"""Host-side span tracing — Chrome-trace-event output with an XLA bridge
+(``docs/observability.md``).
+
+The reference repo's timing story is two ``time.time()`` reads around the
+epoch loop printed on rank 0; ``jax.profiler`` captures the DEVICE side but
+says nothing about the host work that starves it (checkpoint serialization,
+loader waits, eval loops). This module records **host spans** on a
+monotonic clock (``time.perf_counter``) and emits them in the Chrome
+trace-event format, so one file loads in Perfetto / ``chrome://tracing``
+and shows the host timeline; each span additionally enters a
+``jax.profiler.TraceAnnotation`` while open, so when an XLA profile is
+being captured (``--profile_dir``), the SAME spans appear as named ranges
+on the XLA timeline — host and device views line up by construction.
+
+Contract (audited by TD106): arming the recorder changes NOTHING inside
+the traced train step — spans wrap host code only, and a disabled
+recorder's :func:`span` returns a shared no-op context (one global read,
+no allocation). Nesting needs no explicit stack: complete (``"ph": "X"``)
+events on the same thread nest by interval containment, which is exactly
+how the viewers render them.
+
+Usage::
+
+    spans.enable()
+    with spans.span("ckpt/save", epoch=3):
+        ...
+    spans.export_chrome_trace("trace.json")   # or drain() into history
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+#: Cap on buffered events: a week-long run must not grow host memory
+#: without bound. Overflow drops new events and counts them (the count is
+#: surfaced in the exported trace metadata, never silently).
+MAX_EVENTS = 200_000
+
+_LOCK = threading.Lock()
+_ENABLED = False
+_EVENTS: List[dict] = []
+_DROPPED = 0
+_PID = 0
+# One clock zero for every event in the process, set at import and reset by
+# enable(): perf_counter is monotonic and sub-microsecond, and a common
+# origin keeps cross-thread spans comparable in the viewer.
+_T0 = time.perf_counter()
+_ANNOTATION = None  # cached jax.profiler.TraceAnnotation (resolved lazily)
+
+
+class _NullSpan:
+    """Shared do-nothing context for the disabled recorder."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "args", "_t0", "_ann")
+
+    def __init__(self, name: str, args: Dict[str, object]):
+        self.name = name
+        self.args = args
+        self._ann = None
+
+    def __enter__(self):
+        ann = _ANNOTATION
+        if ann is not None:
+            # bridge: while this host span is open, the XLA profiler (when
+            # capturing) tags device activity with the same name
+            self._ann = ann(self.name)
+            self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        end = time.perf_counter()
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        add_event(self.name, self._t0, end - self._t0, **self.args)
+        return False
+
+
+def span(name: str, **args):
+    """Context manager timing a host region. Free when disabled."""
+    if not _ENABLED:
+        return _NULL
+    return _Span(name, args)
+
+
+def add_event(name: str, t_start: float, duration: float, **args) -> None:
+    """Record an already-timed region (``t_start`` from
+    ``time.perf_counter()``). Lets call sites that measure phases anyway
+    (the trainer's step-phase split) emit spans without double-timing."""
+    global _DROPPED
+    if not _ENABLED:
+        return
+    evt = {
+        "name": name,
+        "ph": "X",
+        "ts": round((t_start - _T0) * 1e6, 1),  # Chrome traces are in us
+        "dur": round(duration * 1e6, 1),
+        "pid": _PID,
+        "tid": threading.get_ident() & 0x7FFFFFFF,
+    }
+    if args:
+        evt["args"] = args
+    with _LOCK:
+        if len(_EVENTS) >= MAX_EVENTS:
+            _DROPPED += 1
+            return
+        _EVENTS.append(evt)
+
+
+def enable(fresh: bool = True) -> None:
+    """Arm the recorder (fresh buffer, clock re-zeroed). Rank-agnostic:
+    every process MAY record; the trainer only enables (and exports) on
+    rank 0, keeping the rank-0 output discipline.
+
+    ``fresh=False`` re-arms WITHOUT clearing the buffer or moving the
+    clock origin — for tooling (the TD106 audit) that must not destroy a
+    live recorder's undrained events or shift later timestamps."""
+    global _ENABLED, _DROPPED, _T0, _PID, _ANNOTATION
+    if fresh:
+        with _LOCK:
+            _EVENTS.clear()
+            _DROPPED = 0
+        _T0 = time.perf_counter()
+    try:  # resolve the bridge + process id once, not per span
+        import jax  # noqa: PLC0415
+
+        _ANNOTATION = jax.profiler.TraceAnnotation
+        _PID = jax.process_index()
+    except Exception:  # jax absent/uninitialized: host-only tracing still works
+        _ANNOTATION = None
+        _PID = 0
+    _ENABLED = True
+
+
+def disable() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def events() -> List[dict]:
+    """Copy of the buffered events (oldest first)."""
+    with _LOCK:
+        return list(_EVENTS)
+
+
+def dropped() -> int:
+    with _LOCK:
+        return _DROPPED
+
+
+def drain() -> List[dict]:
+    """Return AND clear the buffer — the trainer calls this at epoch ends
+    to move spans into the JSONL history incrementally (bounded memory)."""
+    with _LOCK:
+        out = list(_EVENTS)
+        _EVENTS.clear()
+        return out
+
+
+def to_chrome_trace(extra_events: Optional[List[dict]] = None) -> dict:
+    """The Perfetto/chrome://tracing JSON object for the buffered (plus any
+    caller-supplied) events."""
+    evts = events()
+    if extra_events:
+        evts = extra_events + evts
+    out = {"traceEvents": evts, "displayTimeUnit": "ms"}
+    d = dropped()
+    if d:
+        out["metadata"] = {"tpu_dist_dropped_events": d}
+    return out
+
+
+def export_chrome_trace(path: str, extra_events: Optional[List[dict]] = None) -> str:
+    """Write the Chrome trace JSON to ``path``; returns the path. Caller
+    owns the rank-0 guard (the trainer exports on the primary only)."""
+    # tpu-dist: ignore[TD002] — the trainer calls this under its rank-0
+    # telemetry guard; standalone users own their own process discipline
+    with open(path, "w") as f:
+        json.dump(to_chrome_trace(extra_events), f)
+    return path

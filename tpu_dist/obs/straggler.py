@@ -1,0 +1,83 @@
+"""Per-host straggler detection (``docs/observability.md``).
+
+Pod-scale studies attribute most TPU scaling loss to per-host skew and
+input stalls (MLPerf-0.6 on TPU-v3 pods, arXiv:1909.09756; Kumar et al.,
+arXiv:2011.03641): one host with a slow disk or a hot neighbor drags every
+step, because the collectives make the pod march at the slowest host's
+pace. The signal is cheap to compute and this repo simply never looked: at
+each epoch end, allgather every process's ``(epoch_time, data_stall_frac)``
+and compare max against median.
+
+This is a HOST-grain check (one value per process, a few floats over DCN,
+once per epoch) — not a per-step device profiler. The allgather is a
+collective: every process must call :func:`epoch_skew` at the same point
+(the trainer does, right after each epoch), which is also why the check
+lives outside the traced step and costs TD106 nothing.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from tpu_dist.metrics.logging import rank0_print
+from tpu_dist.obs import counters
+
+
+def _default_allgather(row: np.ndarray) -> np.ndarray:
+    import jax  # noqa: PLC0415
+
+    if jax.process_count() <= 1:
+        return row[None, :]
+    from jax.experimental import multihost_utils  # noqa: PLC0415
+
+    return np.asarray(multihost_utils.process_allgather(row))
+
+
+def epoch_skew(
+    epoch_time: float,
+    stall_frac: float = 0.0,
+    *,
+    epoch: Optional[int] = None,
+    threshold: float = 1.5,
+    allgather: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+) -> dict:
+    """Allgather this process's epoch walltime + stall fraction, compute
+    the max/median skew, and rank-0-warn when it exceeds ``threshold``.
+
+    COLLECTIVE: every process must reach this call once per epoch.
+    ``allgather`` is injectable for tests (rows of ``[time, stall]``).
+    Returns the skew record (also what the trainer logs to history)::
+
+        {"skew": 1.8, "straggler": True, "worst_rank": 3,
+         "median_s": 10.2, "max_s": 18.4,
+         "epoch_times": [...], "stall_fracs": [...]}
+    """
+    gather = allgather or _default_allgather
+    rows = np.asarray(
+        gather(np.asarray([epoch_time, stall_frac], np.float64)), np.float64
+    ).reshape(-1, 2)
+    times, stalls = rows[:, 0], rows[:, 1]
+    median = float(np.median(times))
+    worst = int(np.argmax(times))
+    skew = float(times[worst] / median) if median > 0 else 1.0
+    rec = {
+        "skew": round(skew, 4),
+        "straggler": bool(threshold > 0 and skew > threshold),
+        "worst_rank": worst,
+        "median_s": round(median, 4),
+        "max_s": round(float(times[worst]), 4),
+        "epoch_times": [round(float(t), 4) for t in times],
+        "stall_fracs": [round(float(s), 4) for s in stalls],
+    }
+    if rec["straggler"]:
+        counters.inc("straggler.epochs_flagged")
+        rank0_print(
+            f"WARNING: straggler detected{f' (epoch {epoch})' if epoch is not None else ''}: "
+            f"process {worst} took {rec['max_s']:.2f}s vs median "
+            f"{rec['median_s']:.2f}s ({skew:.2f}x > threshold {threshold}x); "
+            f"its data-stall fraction is {float(stalls[worst]):.2%} — "
+            "check that host's input pipeline/disk before blaming the model"
+        )
+    return rec

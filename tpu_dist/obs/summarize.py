@@ -1,0 +1,218 @@
+"""Offline analysis of a run's JSONL history (``--log_file``) — the engine
+behind ``python -m tpu_dist.obs summarize`` / ``export-trace``.
+
+Pure host-side file crunching: this module itself never touches jax, so
+the report runs anywhere the package imports (a laptop holding a pod
+run's log). Input is the :class:`MetricsHistory` JSONL
+schema (``docs/observability.md``): one object per line, ``kind`` keyed —
+``train_epoch`` (throughput, step-time percentiles, stall fraction, a
+counter-registry snapshot), ``eval``, ``straggler``, ``spans`` (drained
+Chrome trace events), ``auto_recover``. A torn trailing line (the process
+died mid-write) is tolerated and reported, not fatal.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Tuple
+
+from tpu_dist.obs import counters as counters_lib
+
+
+def load_records(path: str) -> Tuple[List[dict], int]:
+    """Parse the JSONL; returns ``(records, n_bad_lines)``."""
+    records: List[dict] = []
+    bad = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1  # torn tail from a killed writer — report, keep going
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+            else:
+                bad += 1
+    return records, bad
+
+
+def summarize(records: List[dict], bad_lines: int = 0) -> dict:
+    """The per-epoch report: throughput, step-time percentiles, data-stall
+    fraction, counter deltas (vs the previous epoch's snapshot), eval and
+    straggler results merged in by epoch."""
+    epochs: List[dict] = []
+    evals = {}
+    stragglers = []
+    recoveries = 0
+    prev_counters: Optional[dict] = None
+    prev_run_id = None
+    final_counters: Optional[dict] = None
+    run_id = None
+    schema = None
+    for rec in records:
+        kind = rec.get("kind")
+        run_id = rec.get("run_id", run_id)
+        schema = rec.get("schema_version", schema)
+        rid = rec.get("run_id")
+        if rid is not None and rid != prev_run_id:
+            # resume boundary (same --log_file, fresh process + counter
+            # registry): deltas across it would go negative/meaningless
+            prev_counters = None
+            prev_run_id = rid
+        if kind == "eval":
+            evals[rec.get("epoch")] = rec
+        elif kind == "straggler":
+            stragglers.append(
+                {k: rec.get(k) for k in ("epoch", "skew", "worst_rank", "max_s", "median_s")}
+            )
+        elif kind == "auto_recover":
+            recoveries += 1
+        if isinstance(rec.get("counters"), dict):
+            final_counters = rec["counters"]
+        if kind != "train_epoch":
+            continue
+        cur_counters = rec.get("counters") if isinstance(rec.get("counters"), dict) else None
+        row = {
+            "epoch": rec.get("epoch"),
+            "images_per_sec": rec.get("images_per_sec"),
+            "epoch_time_s": rec.get("epoch_time"),
+            "step_time_p50_s": rec.get("step_time_p50"),
+            "step_time_p95_s": rec.get("step_time_p95"),
+            "step_time_p99_s": rec.get("step_time_p99"),
+            "data_stall_frac": rec.get("data_stall_frac"),
+            "loss": rec.get("loss"),
+        }
+        if cur_counters is not None:
+            row["counter_deltas"] = counters_lib.delta(prev_counters, cur_counters)
+            prev_counters = cur_counters
+        epochs.append(row)
+    for row in epochs:
+        ev = evals.get(row["epoch"])
+        if ev is not None:
+            row["val_top1"] = ev.get("top1")
+    times = [r["epoch_time_s"] for r in epochs if r.get("epoch_time_s")]
+    ips = [r["images_per_sec"] for r in epochs if r.get("images_per_sec")]
+    out = {
+        "run_id": run_id,
+        "schema_version": schema,
+        "n_records": len(records),
+        "bad_lines": bad_lines,
+        "epochs": epochs,
+        "stragglers": stragglers,
+        "auto_recoveries": recoveries,
+        "totals": {
+            "n_epochs": len(epochs),
+            "total_train_time_s": round(sum(times), 3) if times else 0.0,
+            "images_per_sec_mean": round(sum(ips) / len(ips), 1) if ips else None,
+            "counters": final_counters or {},
+        },
+    }
+    return out
+
+
+def _fmt(v, spec: str, width: int) -> str:
+    return (format(v, spec) if v is not None else "-").rjust(width)
+
+
+def format_text(report: dict) -> str:
+    """Human-readable rendering of :func:`summarize`'s report."""
+    lines = []
+    rid = report.get("run_id")
+    lines.append(
+        f"run {rid or '<no run_id>'} — {report['totals']['n_epochs']} epoch(s), "
+        f"{report['n_records']} record(s)"
+        + (f", {report['bad_lines']} unparsable line(s)" if report["bad_lines"] else "")
+    )
+    hdr = (
+        f"{'epoch':>5} {'img/s':>9} {'epoch_s':>8} {'p50_ms':>8} "
+        f"{'p95_ms':>8} {'p99_ms':>8} {'stall%':>7} {'loss':>9} {'val_top1':>9}"
+    )
+    lines.append(hdr)
+    for r in report["epochs"]:
+        ms = lambda v: v * 1e3 if v is not None else None  # noqa: E731
+        lines.append(
+            f"{_fmt(r['epoch'], 'd', 5)} {_fmt(r['images_per_sec'], '.1f', 9)} "
+            f"{_fmt(r['epoch_time_s'], '.2f', 8)} {_fmt(ms(r['step_time_p50_s']), '.1f', 8)} "
+            f"{_fmt(ms(r['step_time_p95_s']), '.1f', 8)} {_fmt(ms(r['step_time_p99_s']), '.1f', 8)} "
+            f"{_fmt(r['data_stall_frac'] * 100 if r['data_stall_frac'] is not None else None, '.1f', 7)} "
+            f"{_fmt(r['loss'], '.4f', 9)} {_fmt(r.get('val_top1'), '.2f', 9)}"
+        )
+        deltas = r.get("counter_deltas") or {}
+        if deltas:
+            body = ", ".join(f"{k}+{v:g}" for k, v in sorted(deltas.items()))
+            lines.append(f"      counters: {body}")
+    for s in report["stragglers"]:
+        lines.append(
+            f"straggler: epoch {s.get('epoch')} process {s.get('worst_rank')} "
+            f"at {s.get('skew')}x median ({s.get('max_s')}s vs {s.get('median_s')}s)"
+        )
+    if report["auto_recoveries"]:
+        lines.append(f"auto-recoveries: {report['auto_recoveries']}")
+    t = report["totals"]
+    lines.append(
+        f"total: {t['total_train_time_s']}s train"
+        + (f", mean {t['images_per_sec_mean']} img/s" if t["images_per_sec_mean"] else "")
+    )
+    cnt = t.get("counters") or {}
+    if cnt:
+        lines.append("final counters:")
+        for k in sorted(cnt):
+            lines.append(f"  {k} = {cnt[k]}")
+    return "\n".join(lines)
+
+
+def export_trace(records: List[dict]) -> dict:
+    """Chrome trace-event JSON from a run's history: the ``spans`` records'
+    drained events, plus synthesized epoch/eval bars (from each record's
+    monotonic ``rel_s``) so even a span-less log yields a loadable
+    timeline.
+
+    Resumed runs append to the same log with a fresh ``run_id`` AND a
+    restarted clock (``rel_s`` and the span recorder both re-zero in the
+    new process), so each run segment is shifted to start where the
+    previous one ended — Perfetto shows sequential segments, not two runs
+    overlapping at ts≈0."""
+    events: List[dict] = []
+    offset_s = 0.0   # where the current segment's clock-zero sits globally
+    seg_end_s = 0.0  # furthest global timestamp seen so far
+    seen_run = False
+    cur_run = None
+    for rec in records:
+        rid = rec.get("run_id")
+        if not seen_run or rid != cur_run:
+            if seen_run:
+                offset_s = seg_end_s  # resume boundary: new clock origin
+            cur_run, seen_run = rid, True
+        kind = rec.get("kind")
+        rel = rec.get("rel_s")
+        if rel is not None:
+            seg_end_s = max(seg_end_s, offset_s + float(rel))
+        if kind == "spans" and isinstance(rec.get("events"), list):
+            for e in rec["events"]:
+                if not isinstance(e, dict):
+                    continue
+                e = {**e, "ts": round(float(e.get("ts", 0)) + offset_s * 1e6, 1)}
+                events.append(e)
+                seg_end_s = max(
+                    seg_end_s, (e["ts"] + float(e.get("dur", 0))) / 1e6
+                )
+        if kind in ("train_epoch", "eval") and rel is not None:
+            dur = float(rec.get("epoch_time") or 0.0) if kind == "train_epoch" else 0.0
+            # the record is stamped at the END of the region
+            ts = (offset_s + float(rel) - dur) * 1e6
+            events.append(
+                {
+                    "name": f"{kind}/{rec.get('epoch')}",
+                    "ph": "X",
+                    "ts": round(max(ts, offset_s * 1e6), 1),
+                    "dur": round(dur * 1e6, 1),
+                    "pid": 0,
+                    "tid": 0,
+                    "args": {"kind": kind, "epoch": rec.get("epoch")},
+                }
+            )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -34,9 +34,12 @@ Each clause fires ``times`` times (default 1) and then disarms. Injection
 points call the ``on_*`` hooks below; with no plan installed every hook is
 a single attribute read + ``None`` check — and all hooks are host-side, so
 the traced train step is unchanged whether or not a plan is armed (audited
-by TD105 in ``tpu_dist.analysis``).
+by TD105 in ``tpu_dist.analysis``). Every firing increments the
+``faults.injected`` telemetry counter (``tpu_dist.obs.counters``), so a
+chaos run's history records how many faults actually landed.
 
-This module must not import jax.
+This module must not import jax. (``tpu_dist.obs.counters`` is
+jax-free by the same contract.)
 """
 
 from __future__ import annotations
@@ -46,6 +49,8 @@ import os
 import re
 import signal
 from typing import Dict, FrozenSet, List, Optional
+
+from tpu_dist.obs import counters as _counters
 
 ENV_VAR = "TPU_DIST_FAULT_PLAN"
 
@@ -187,6 +192,13 @@ class FaultPlan:
 _PLAN: Optional[FaultPlan] = None
 
 
+def _record_fired(site: str) -> None:
+    """Telemetry: every fault that actually lands is counted (total and
+    per-site), so a chaos run's history shows the injection schedule."""
+    _counters.inc("faults.injected")
+    _counters.inc(f"faults.{site}")
+
+
 def install(plan) -> FaultPlan:
     """Install a :class:`FaultPlan` (or parse a spec string) as THE active
     plan; returns it. Counters start fresh."""
@@ -238,6 +250,7 @@ def on_ckpt_write() -> None:
         first = int(c.params["call"])
         if first <= plan.ckpt_write_calls < first + c.times:
             c.fired += 1
+            _record_fired("ckpt_write")
             eno = int(c.params.get("errno", 5))  # EIO
             raise OSError(
                 eno,
@@ -259,6 +272,7 @@ def on_ckpt_published(path: str) -> Optional[str]:
     epoch = int(m.group(1))
     for c in plan._matching("ckpt_corrupt", epoch=epoch):
         c.fired += 1
+        _record_fired("ckpt_corrupt")
         mode = str(c.params.get("mode", "truncate"))
         if mode == "truncate":
             truncate_file(path, frac=float(c.params.get("frac", 0.5)))
@@ -278,9 +292,11 @@ def on_step(epoch: int, step: int) -> FrozenSet[str]:
     actions = set()
     for c in plan._matching("nan_loss", epoch=epoch, step=step):
         c.fired += 1
+        _record_fired("nan_loss")
         actions.add(NAN_LOSS)
     for c in plan._matching("sigterm", epoch=epoch, step=step):
         c.fired += 1
+        _record_fired("sigterm")
         actions.add(SIGTERM)
         os.kill(os.getpid(), signal.SIGTERM)
     return frozenset(actions)
@@ -299,6 +315,7 @@ def on_loader_batch(batch: int, epoch: Optional[int] = None) -> Optional[str]:
         coords["epoch"] = epoch
     for c in plan._matching("loader_stall", **coords):
         c.fired += 1
+        _record_fired("loader_stall")
         return "die"
     return None
 

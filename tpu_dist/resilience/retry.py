@@ -51,7 +51,9 @@ def retry_call(
             return fn(*args, **kwargs)
         except retry_on as e:
             from tpu_dist.metrics.logging import rank0_print  # noqa: PLC0415
+            from tpu_dist.obs import counters  # noqa: PLC0415
 
+            counters.inc("io.retries")
             rank0_print(
                 f"WARNING: transient {'I/O' if not describe else describe} "
                 f"failure (attempt {attempt + 1}/{retries + 1}): {e} — "

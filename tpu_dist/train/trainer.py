@@ -44,7 +44,10 @@ from tpu_dist.data import (
 )
 from tpu_dist.evaluation import validate
 from tpu_dist.metrics import AverageMeter, rank0_print
+from tpu_dist.metrics.profiler import StepTimer
 from tpu_dist.nn import resnet18, resnet34, resnet50
+from tpu_dist.obs import counters as counters_lib
+from tpu_dist.obs import spans as spans_lib
 from tpu_dist.resilience import faults, preemption
 from tpu_dist.resilience.preemption import PreemptedError
 from tpu_dist.train.optim import SGD, cosine_lr, multistep_lr
@@ -124,6 +127,14 @@ class Trainer:
             raise
 
     def _init_impl(self, cfg: TrainConfig, mesh):
+        # the telemetry counter registry is process-global and a "run" is
+        # one Trainer's lifetime (run_id is stamped per construction, so
+        # repeated fit() calls on one instance share it): start the
+        # registry fresh here so a second Trainer in the same process
+        # (tests, sweep drivers) doesn't report the previous run's totals
+        # under its fresh run_id — and so the restore ladder's counters
+        # (which run during THIS construction, below) attribute to this run
+        counters_lib.reset()
         if cfg.compile_cache_dir:
             # persistent XLA compile cache (VERDICT r1 #8): a rerun of the
             # same config loads compiled programs instead of recompiling
@@ -741,6 +752,34 @@ class Trainer:
             )
 
         self._async_ckpt = None  # created lazily by _ckpt_io()
+        self._heartbeat = None  # created by fit() (rank 0, --heartbeat_file)
+        self._trace_events = []  # drained spans held for --trace_file export
+        self._step_traced = False  # first dispatch of THIS Trainer compiles
+        # run identity: config hash + construction second, stamped ONCE per
+        # Trainer (docs/observability.md) — every history record of this
+        # run carries the same id, repeated fit() calls included, and a
+        # resume (new process, same config) gets a fresh one
+        import dataclasses as _dc  # noqa: PLC0415
+        import hashlib  # noqa: PLC0415
+        import json as _json  # noqa: PLC0415
+
+        cfg_hash = hashlib.sha1(
+            _json.dumps(_dc.asdict(cfg), sort_keys=True, default=str).encode()
+        ).hexdigest()[:8]
+        self._run_id = f"{cfg_hash}-{int(time.time())}"
+        # arm host-span tracing on the primary BEFORE the resume-path
+        # restore below, so the restore ladder's ckpt/restore spans land in
+        # the trace (fit() re-arms with fresh=False, keeping them). The
+        # monotonic stamp here is the run's single clock origin: the span
+        # recorder zeroes on it now, and fit() hands it to MetricsHistory
+        # as the rel_s origin — exported epoch bars and spans line up, and
+        # a second fit() on this instance continues the same timeline.
+        self._telemetry = bool(
+            mesh_lib.is_primary() and (cfg.log_file or cfg.trace_file)
+        )
+        self._telemetry_t0 = time.monotonic()
+        if self._telemetry:
+            spans_lib.enable()
         self.start_epoch = 0
         self._resume_step = 0  # >0 only after restoring a mid-epoch snapshot
         # atomic training position for _emergency_save: (state, epoch,
@@ -1027,19 +1066,59 @@ class Trainer:
         t0 = time.time()
         nb = len(self.train_loader)
         metrics = {}
+        # Step-phase split on EXISTING sync points only (docs/observability
+        # .md): data-wait = blocking in the loader iterator, dispatch = the
+        # train_step call (async enqueue; step 0 also holds the compile),
+        # host-fetch = the metric device_get the loop already does. No new
+        # device_get/block_until_ready enters the hot loop — TD106 and the
+        # fetch-count test pin that.
+        timer = StepTimer(warmup_steps=1)  # lap 0 would be the compile step
+        phase = {"data": 0.0, "dispatch": 0.0, "fetch": 0.0}
+        hb = self._heartbeat
+        steps_run = 0
+
+        def timed_batches(src):
+            it = iter(src)
+            while True:
+                t_w = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+                d = time.perf_counter() - t_w
+                phase["data"] += d
+                spans_lib.add_event("train/data_wait", t_w, d, epoch=epoch)
+                yield item
+
         # (state, epoch, completed steps, epoch_complete) published as ONE
         # attribute so an interrupt can never observe a half-updated pair —
         # _emergency_save reads ONLY this to decide what to snapshot
         self._progress = (self.state, epoch, start_step, False)
         for step, (images, labels) in enumerate(
-            self.train_loader.iter_from(start_step), start=start_step
+            timed_batches(self.train_loader.iter_from(start_step)),
+            start=start_step,
         ):
             if cfg.steps_per_epoch is not None and step >= cfg.steps_per_epoch:
                 break
+            t_d = time.perf_counter()
             new_state, metrics = self.train_step(self.state, images, labels, lr)
+            d_d = time.perf_counter() - t_d
+            phase["dispatch"] += d_d
+            spans_lib.add_event(
+                # only THIS Trainer's very first dispatch holds the trace/
+                # compile — epoch 2's step 0 is a plain dispatch and must
+                # not read as a retrace in the exported timeline
+                "train/dispatch" if self._step_traced else "train/compile+dispatch",
+                t_d, d_d, step=step,
+            )
+            self._step_traced = True
             self._progress = (new_state, epoch, step + 1, False)
             self.state = new_state
             images_seen += cfg.batch_size
+            steps_run += 1
+            timer.tick()
+            if hb is not None:
+                hb.beat(epoch=epoch, step=step)
             if faults.active() is not None:  # zero-cost when no --fault_plan
                 self._apply_step_faults(epoch, step, lr)
             want_save = (
@@ -1050,7 +1129,10 @@ class Trainer:
             want_log = step % cfg.log_every == 0
             # ONE device fetch serves the snapshot's NaN guard AND the log
             # line — neither issues its own per-key sync
+            t_f = time.perf_counter()
             m = _fetch_metrics(metrics) if (want_save or want_log) else None
+            if m is not None:
+                phase["fetch"] += time.perf_counter() - t_f
             if want_save:
                 # periodic EXACT snapshot (kill-9 safety for long epochs):
                 # same stamp as the interrupt path — ckpt_{epoch} carries
@@ -1116,6 +1198,30 @@ class Trainer:
             f"Epoch {epoch} done in {dt:.2f}s ({ips:.0f} img/s, avg loss {losses.avg:.4f})"
         )
         out.update(epoch_time=dt, images_per_sec=ips)
+        # step-phase summary: tail latency + where the wall time went
+        # (host clocks only — no device sync was added to produce these)
+        stall = phase["data"] / dt if dt > 0 else 0.0
+        out.update(
+            steps=steps_run,
+            data_wait_s=round(phase["data"], 4),
+            dispatch_s=round(phase["dispatch"], 4),
+            host_fetch_s=round(phase["fetch"], 4),
+            data_stall_frac=round(stall, 4),
+        )
+        pct = timer.percentiles()
+        if pct:
+            out.update(
+                step_time_p50=round(pct["p50"], 6),
+                step_time_p95=round(pct["p95"], 6),
+                step_time_p99=round(pct["p99"], 6),
+            )
+            rank0_print(
+                f"  step p50/p95/p99 {pct['p50'] * 1e3:.1f}/"
+                f"{pct['p95'] * 1e3:.1f}/{pct['p99'] * 1e3:.1f} ms, "
+                f"data stall {stall:.1%}"
+            )
+        counters_lib.inc("train.epochs")
+        counters_lib.inc("train.steps", steps_run)
         return out
 
     def _train_epoch_fused(self, epoch: int) -> dict:
@@ -1126,10 +1232,19 @@ class Trainer:
         self._progress = (self.state, epoch, 0, False)
         lr = self._lr(epoch)
         t0 = time.time()
+        t_pc = time.perf_counter()
         self.state, metrics = self._fused_runner(
             self.state, *self._fused_data, lr, epoch
         )
         m = _fetch_metrics(metrics)  # one transfer; blocks on completion
+        # the fused epoch has no step grain: one span covers the whole
+        # compiled call (compile included on its first trip)
+        spans_lib.add_event(
+            "train/fused_epoch", t_pc, time.perf_counter() - t_pc, epoch=epoch
+        )
+        counters_lib.inc("train.epochs")
+        if self._heartbeat is not None:
+            self._heartbeat.beat(epoch=epoch, phase="fused_epoch", force=True)
         if cfg.nan_guard and not np.isfinite(m["loss"]):
             raise TrainingDivergedError(
                 f"non-finite loss {m['loss']} in fused epoch {epoch} (lr={lr}); "
@@ -1143,7 +1258,8 @@ class Trainer:
             f"loss={m['loss']:.4f} acc1={m['acc1']:.2f} acc5={m['acc5']:.2f}"
         )
         rank0_print(f"Epoch {epoch} done in {dt:.2f}s ({ips:.0f} img/s)")
-        m.update(epoch_time=dt, images_per_sec=ips)
+        # device-resident data: there IS no input pipeline to stall on
+        m.update(epoch_time=dt, images_per_sec=ips, data_stall_frac=0.0)
         if preemption.requested():
             # the fused epoch has no step grain — the epoch boundary is the
             # first cooperative point a SIGTERM can be honored at. The epoch
@@ -1297,7 +1413,8 @@ class Trainer:
             # wrong checkpoint must raise, not be quarantined as corrupt
             self._check_ckpt_meta(meta, path)
             try:
-                restored = restore_(path, self.state)
+                with spans_lib.span("ckpt/restore_ladder", file=path):
+                    restored = restore_(path, self.state)
             except (ckpt_lib.CheckpointCorruptError,) + ckpt_lib.CKPT_READ_ERRORS as e:
                 # plain format verifies CRCs HERE (fused into restore's
                 # read); sharded piece-level corruption also lands here
@@ -1373,7 +1490,48 @@ class Trainer:
         epochs = epochs if epochs is not None else cfg.epochs
         from tpu_dist.metrics.history import MetricsHistory  # noqa: PLC0415
 
-        history = MetricsHistory(cfg.log_file)
+        run_id = self._run_id  # stamped at construction (one id per run)
+        # rel_s shares the construction-time clock origin with the span
+        # recorder — one timeline for epoch bars and host spans
+        history = MetricsHistory(
+            cfg.log_file, run_id=run_id, t0=self._telemetry_t0
+        )
+        # re-arm host-span tracing (construction armed it before the
+        # resume-path restore; a second fit() on this Trainer re-arms after
+        # _export_telemetry disarmed) WITHOUT clearing or re-zeroing — the
+        # restore ladder's spans are still in the buffer and the clock
+        # origin must stay the construction instant. Counters are always
+        # live — they are plain host ints.
+        telemetry = self._telemetry
+        if telemetry:
+            spans_lib.enable(fresh=False)
+            counters_lib.set_gauge("run.id", run_id)
+            counters_lib.set_gauge("run.grad_compression", cfg.grad_compression)
+            if not cfg.fsdp:  # under fsdp the wire format is inert (GSPMD)
+                # static ring-model estimate, pure host arithmetic from the
+                # param SHAPES (no device touch): RS+AG = 2 payload legs ×
+                # bytes/elem of the wire format. The exact per-eqn account
+                # is TD104's job; this gauge puts the mode's wire cost next
+                # to the throughput numbers in every history record.
+                import math  # noqa: PLC0415
+
+                n_params = sum(
+                    math.prod(l.shape) if l.shape else 1
+                    for l in jax.tree_util.tree_leaves(self.state.params)
+                )
+                bpe = {"none": 4, "bf16": 2, "int8": 1, "int8_ef": 1}[
+                    cfg.grad_compression
+                ]
+                counters_lib.set_gauge(
+                    "comm.grad_wire_bytes_per_step", 2 * bpe * n_params
+                )
+        if cfg.heartbeat_file and mesh_lib.is_primary():
+            from tpu_dist.obs.heartbeat import Heartbeat  # noqa: PLC0415
+
+            self._heartbeat = Heartbeat(cfg.heartbeat_file)
+            self._heartbeat.beat(
+                epoch=self.start_epoch, phase="start", force=True
+            )
         last = {}
         self._last_epoch = self.start_epoch
         self._in_epoch = False
@@ -1394,6 +1552,9 @@ class Trainer:
                 try:
                     result = self._fit_loop(epochs, history, last)
                     self._ckpt_close()  # success path: writer errors RAISE
+                    if self._heartbeat is not None:
+                        # clean exit: the heartbeat's ABSENCE is the signal
+                        self._heartbeat.sweep()
                     return result
                 except TrainingDivergedError as e:
                     # from here until the restore completes, self.state is
@@ -1407,10 +1568,19 @@ class Trainer:
                         "auto_recover", epoch=self._last_epoch,
                         lr_scale=self._lr_scale,
                     )
-        except (KeyboardInterrupt, PreemptedError):
+        except (KeyboardInterrupt, PreemptedError) as e:
             # Ctrl-C and SIGTERM share one snapshot discipline; the caller
             # (cli/train.py) maps PreemptedError to the distinct
             # PREEMPTION_EXIT_CODE so the launcher/orchestrator can requeue
+            if isinstance(e, PreemptedError):
+                counters_lib.inc("preemption.observed")
+            if self._heartbeat is not None:
+                # last beat marks the position; the file is deliberately
+                # NOT swept — a watchdog seeing it + the exit code knows
+                # the run ended preempted/interrupted, not hung
+                self._heartbeat.beat(
+                    epoch=self._last_epoch, phase="preempted", force=True
+                )
             self._emergency_save()
             raise
         finally:
@@ -1421,6 +1591,10 @@ class Trainer:
             self._ckpt_close(suppress=True)
             if self._tb is not None:
                 self._tb.close()
+            if telemetry:
+                self._export_telemetry(history)
+            history.close()
+            self._heartbeat = None
 
     def _emergency_save(self) -> None:
         """Ctrl-C / SIGTERM snapshot discipline (one path for both: the
@@ -1554,6 +1728,52 @@ class Trainer:
              f"{cfg.ckpt_dir} as epoch {prev} — resume re-runs epoch "
              f"{epoch}")
 
+    def _export_telemetry(self, history) -> None:
+        """End-of-run span disposal (rank 0 — fit() arms telemetry there
+        only): drain the tail into the JSONL history, write --trace_file,
+        disarm the recorder. Best-effort: telemetry must never mask a
+        propagating training error."""
+        cfg = self.cfg
+        try:
+            # one drain path (history record + capped accumulator with
+            # counted drops) for the tail too — no silent overflow here
+            self._drain_spans(history, self._last_epoch)
+            if cfg.trace_file:
+                spans_lib.export_chrome_trace(
+                    cfg.trace_file, extra_events=self._trace_events
+                )
+                rank0_print(
+                    f"=> wrote host-span Chrome trace to {cfg.trace_file} "
+                    f"({len(self._trace_events)} events; load in Perfetto)"
+                )
+        except OSError as e:
+            rank0_print(f"WARNING: telemetry export failed: {e}")
+        finally:
+            spans_lib.disable()
+            self._trace_events = []
+
+    def _drain_spans(self, history, epoch: int) -> None:
+        """Move this epoch's host spans out of the in-memory buffer: into
+        the JSONL history (a ``spans`` record, streamed to disk) and/or
+        the --trace_file accumulator, which is capped at the same
+        MAX_EVENTS budget as the live buffer — a week-long run keeps its
+        earliest events and counts the overflow, never grows unbounded."""
+        if not spans_lib.enabled():
+            return
+        ev = spans_lib.drain()
+        if not ev:
+            return
+        if self.cfg.log_file:
+            history.log("spans", epoch=epoch, events=ev)
+        if self.cfg.trace_file:
+            room = spans_lib.MAX_EVENTS - len(self._trace_events)
+            if room > 0:
+                self._trace_events.extend(ev[:room])
+            if len(ev) > max(room, 0):
+                counters_lib.inc(
+                    "spans.trace_export_dropped", len(ev) - max(room, 0)
+                )
+
     def _fit_loop(self, epochs: int, history, last: dict) -> dict:
         cfg = self.cfg
         for epoch in range(self.start_epoch, epochs):
@@ -1576,6 +1796,20 @@ class Trainer:
             # "complete through epoch" for the eval/save window below
             self._progress = (self.state, epoch, 0, True)
             history.log("train_epoch", epoch=epoch, **last)
+            self._drain_spans(history, epoch)
+            if cfg.straggler_threshold > 0:
+                # COLLECTIVE (allgather of two floats per process): every
+                # process reaches this once per epoch — same contract as
+                # the restore ladder's agreement check
+                from tpu_dist.obs import straggler as straggler_lib  # noqa: PLC0415
+
+                srec = straggler_lib.epoch_skew(
+                    float(last.get("epoch_time", 0.0)),
+                    float(last.get("data_stall_frac", 0.0)),
+                    epoch=epoch, threshold=cfg.straggler_threshold,
+                )
+                if srec["straggler"]:
+                    history.log("straggler", epoch=epoch, **srec)
             if self._tb is not None:
                 for k in ("loss", "acc1", "acc5", "images_per_sec"):
                     if k in last:
@@ -1583,8 +1817,13 @@ class Trainer:
                 self._tb.add_scalar("train/lr", self._lr(epoch), epoch)
             if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
                 if self._fused_runner is not None:
+                    t_ev = time.perf_counter()
                     sums = _fetch_metrics(
                         self._fused_eval(self.state, *self._fused_test_data)
+                    )
+                    spans_lib.add_event(
+                        "eval/fused", t_ev, time.perf_counter() - t_ev,
+                        epoch=epoch,
                     )
                     n = max(sums["count"], 1.0)
                     t1 = sums["top1"] / n * 100.0
