@@ -33,57 +33,35 @@ import numpy as np
 BASELINE_IMG_PER_SEC = 50_000 / 14.5  # DDP+apex, 4x2080Ti (README.md:77)
 CIFAR_TRAIN = 50_000
 
-# Peak dense matmul FLOP/s per chip (bf16), used for the MFU denominator.
-# Public spec-sheet numbers; unknown kinds (incl. CPU emulation) yield
-# mfu=None rather than a made-up figure.
-CHIP_PEAK_FLOPS = {
-    "TPU v2": 45e12,
-    "TPU v3": 123e12,
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v5": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
+
+def _costmodel():
+    """The shared cost/MFU layer (``tpu_dist.obs.costmodel``) — ONE home
+    for the chip-peak table, the ``cost_analysis()`` normalization, and
+    ``memory_analysis()`` reading that this file used to keep private
+    copies of. Imported lazily like every tpu_dist import here (argparse
+    and the lock guard must run before any backend touch)."""
+    from tpu_dist.obs import costmodel
+
+    return costmodel
 
 
-def _chip_peak_flops() -> float | None:
-    import jax
-
-    kind = jax.devices()[0].device_kind
-    for name, peak in sorted(CHIP_PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
-        if kind.startswith(name):
-            return peak
-    return None
-
-
-def _step_flops(compiled, loop_trips: int = 1) -> float | None:
-    """Total FLOPs of one compiled step from XLA's cost analysis (counts the
-    real fwd+bwd+update HLO, not an analytic guess).
-
-    ``loop_trips``: XLA cost analysis counts a while/scan body ONCE, so for
-    steps built around an inner loop (grad accumulation scan, fused-epoch
-    step scan) the caller passes the trip count; the body dominates the
-    program, so multiplying the whole count errs by at most the loop-external
-    ops (a few %, overestimating trips-1 copies of them)."""
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        flops = ca.get("flops")
-        return float(flops) * loop_trips if flops and flops > 0 else None
-    except Exception:
-        return None
+def _step_cost(compiled, loop_trips: int = 1) -> dict:
+    """flops/bytes of one compiled step (see ``costmodel.step_cost`` for
+    the scan-body ``loop_trips`` contract); all-None on failure."""
+    return _costmodel().step_cost(compiled, loop_trips)
 
 
 def _mfu(flops_per_step: float | None, step_seconds: float, n_devices: int) -> float | None:
-    """Model FLOPs utilization: achieved FLOP/s over aggregate chip peak."""
-    peak = _chip_peak_flops()
-    if flops_per_step is None or peak is None or step_seconds <= 0:
-        return None
-    return round(flops_per_step / step_seconds / (peak * n_devices), 4)
+    """Model FLOPs utilization: achieved FLOP/s over aggregate chip peak
+    (None on unknown chips — CPU emulation above all)."""
+    return _costmodel().mfu(flops_per_step, step_seconds, n_devices)
+
+
+def _hbm_fields(compiled) -> dict:
+    """XLA's own executable memory accounting, when the backend reports it:
+    ``{"peak_hbm_bytes": ...}`` or empty."""
+    ma = _costmodel().memory_analysis_bytes(compiled)
+    return {"peak_hbm_bytes": ma["peak_bytes"]} if ma else {}
 
 
 def _wire_audit(fn, *args, trips: int = 1) -> dict | None:
@@ -239,14 +217,17 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None,
     wire = _wire_audit(step, state, images, labels, 0.1)
 
     # AOT-compile once: the same executable serves cost analysis (MFU
-    # numerator) AND the measured loop — no double compile.
+    # numerator), memory accounting, AND the measured loop — no double
+    # compile.
     try:
         compiled = step.lower(state, images, labels, 0.1).compile()
-        flops_per_step = _step_flops(compiled, loop_trips=cfg.grad_accum)
+        cost = _step_cost(compiled, loop_trips=cfg.grad_accum)
+        hbm = _hbm_fields(compiled)
         call = compiled
     except Exception:
-        flops_per_step = None
+        cost, hbm = {"flops_per_step": None, "bytes_per_step": None}, {}
         call = step
+    flops_per_step = cost["flops_per_step"]
 
     for _ in range(warmup):
         state, metrics = call(state, images, labels, 0.1)
@@ -290,6 +271,11 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None,
             f"step_ms_{q}": round(1000 * v, 2) for q, v in sorted(pct.items())
         },
         "mfu": _mfu(flops_per_step, dt / steps, n_dev),
+        # XLA's per-step cost accounting next to the throughput it explains
+        # (same numbers the trainer publishes as device.* gauges)
+        "flops_per_step": cost["flops_per_step"],
+        "bytes_per_step": cost["bytes_per_step"],
+        **hbm,
     }
     if grad_compression != "none":
         out["grad_compression"] = grad_compression
@@ -321,21 +307,22 @@ def _run_fused(cfg: BenchConfig, mesh, model, optimizer, state, n_dev: int,
         compute_dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
         grad_compression=grad_compression,
     )
+    from tpu_dist.train.epoch import fused_steps_per_epoch
+
+    steps_per_epoch = fused_steps_per_epoch(int(dx.shape[0]), batch)
     # whole-epoch program: the scan multiplies per-trip collectives, so
     # normalize the audit back to one step
-    wire = _wire_audit(
-        runner, state, dx, dy, 0.1, 0,
-        trips=max(1, int(dx.shape[0]) // batch),
-    )
+    wire = _wire_audit(runner, state, dx, dy, 0.1, 0, trips=steps_per_epoch)
     # AOT-compile once (cost analysis + the measured loop share it)
     try:
         compiled = runner.lower(state, dx, dy, 0.1, 0).compile()
-        steps_per_epoch = max(1, int(dx.shape[0]) // batch)
-        flops_per_epoch = _step_flops(compiled, loop_trips=steps_per_epoch)
+        cost = _step_cost(compiled, loop_trips=steps_per_epoch)
+        hbm = _hbm_fields(compiled)
         call = compiled
     except Exception:
-        flops_per_epoch = None
+        cost, hbm = {"flops_per_step": None, "bytes_per_step": None}, {}
         call = runner
+    flops_per_epoch = cost["flops_per_step"]  # trips-scaled: whole epoch
 
     # warmup epoch
     state, m = call(state, dx, dy, 0.1, 0)
@@ -361,6 +348,16 @@ def _run_fused(cfg: BenchConfig, mesh, model, optimizer, state, n_dev: int,
         "global_batch": batch,
         "img_per_sec_per_chip": round(img_per_sec / n_dev, 1),
         "mfu": _mfu(flops_per_epoch, dt, n_dev),
+        # per-STEP accounting (divide the trips-scaled epoch totals back)
+        "flops_per_step": (
+            round(flops_per_epoch / steps_per_epoch)
+            if flops_per_epoch else None
+        ),
+        "bytes_per_step": (
+            round(cost["bytes_per_step"] / steps_per_epoch)
+            if cost["bytes_per_step"] else None
+        ),
+        **hbm,
     }
     if grad_compression != "none":
         out["grad_compression"] = grad_compression
@@ -530,7 +527,7 @@ def run_pp(cfg: BenchConfig, steps: int, warmup: int, pp: int,
     )
     try:
         compiled = step.lower(state, images, labels, 0.1).compile()
-        flops = _step_flops(compiled)
+        flops = _step_cost(compiled)["flops_per_step"]
         call = compiled
     except Exception:
         flops = None
@@ -777,12 +774,14 @@ def main() -> None:
             ("grad accumulation ×4", "resnet18_cifar100_ga4"),
             ("fused epoch (device-resident)", "resnet18_cifar100_fused"),
         ]
-        print("| mode | sec/epoch | images/sec | vs 4x2080Ti DDP+apex |")
-        print("|---|---|---|---|")
+        print("| mode | sec/epoch | images/sec | MFU | vs 4x2080Ti DDP+apex |")
+        print("|---|---|---|---|---|")
         for label, name in rows:
             out = run(CONFIGS[name], args.steps, args.warmup)
+            mfu = out.get("mfu")
             print(
                 f"| {label} | {out['sec_per_epoch']} | {out['value']} "
+                f"| {f'{mfu:.1%}' if mfu is not None else 'n/a'} "
                 f"| {out['vs_baseline']}x |"
             )
         return

@@ -240,7 +240,7 @@ def test_history_schema_run_id_rel_s_and_counters(tmp_path):
     lines = [json.loads(l) for l in open(path)]
     assert len(lines) == 2
     for rec in lines:
-        assert rec["schema_version"] == 2
+        assert rec["schema_version"] == 3  # v3: device-health layer
         assert rec["run_id"] == "cfg1234-99"
         assert isinstance(rec["rel_s"], float) and rec["rel_s"] >= 0
         assert "ts" in rec
@@ -279,8 +279,20 @@ def _canned_jsonl(tmp_path):
          "counters": {"ckpt.writes": 1, "loader.batches_consumed": 10}},
         {"ts": 2.0, "rel_s": 6.0, "schema_version": 2, "run_id": "r-1",
          "kind": "eval", "epoch": 0, "top1": 40.0, "top5": 80.0, "loss": 2.2},
-        {"ts": 3.0, "rel_s": 11.0, "schema_version": 2, "run_id": "r-1",
-         "kind": "train_epoch", "epoch": 1, "loss": 2.0,
+        {"ts": 2.5, "rel_s": 8.0, "schema_version": 3, "run_id": "r-1",
+         "kind": "device_stats", "epoch": 1, "step": 0,
+         "grad_norm": 1.5, "param_norm": 12.0, "update_ratio": 0.003,
+         "nonfinite_grads": 0.0},
+        {"ts": 2.6, "rel_s": 9.0, "schema_version": 3, "run_id": "r-1",
+         "kind": "device_stats", "epoch": 1, "step": 2,
+         "grad_norm": 7.0, "param_norm": 12.1, "update_ratio": 0.009,
+         "nonfinite_grads": 0.0},
+        {"ts": 2.7, "rel_s": 9.1, "schema_version": 3, "run_id": "r-1",
+         "kind": "anomaly", "epoch": 1, "step": 2,
+         "anomaly": "grad_norm_explosion", "value": 7.0, "median": 1.5,
+         "ratio": 4.667, "threshold": 4.0},
+        {"ts": 3.0, "rel_s": 11.0, "schema_version": 3, "run_id": "r-1",
+         "kind": "train_epoch", "epoch": 1, "loss": 2.0, "mfu": 0.42,
          "epoch_time": 4.0, "images_per_sec": 1250.0,
          "step_time_p50": 0.009, "step_time_p95": 0.015,
          "step_time_p99": 0.030, "data_stall_frac": 0.10,
@@ -304,7 +316,7 @@ def _canned_jsonl(tmp_path):
 def test_summarize_golden(tmp_path):
     path = _canned_jsonl(tmp_path)
     records, bad = load_records(path)
-    assert len(records) == 5 and bad == 1
+    assert len(records) == 8 and bad == 1
     report = summarize(records, bad)
     assert report["run_id"] == "r-1"
     assert report["totals"]["n_epochs"] == 2
@@ -317,10 +329,25 @@ def test_summarize_golden(tmp_path):
     assert report["stragglers"] == [
         {"epoch": 1, "skew": 2.1, "worst_rank": 3, "max_s": 8.4, "median_s": 4.0}
     ]
+    # v3 health layer: per-epoch device_stats rollup, anomaly list, MFU
+    assert "device_stats" not in e0 and e0["mfu"] is None
+    assert e1["device_stats"] == {
+        "samples": 2, "grad_norm_last": 7.0, "grad_norm_max": 7.0,
+        "update_ratio_last": 0.009, "param_norm_last": 12.1,
+    }
+    assert e1["mfu"] == 0.42
+    assert report["totals"]["mfu_mean"] == pytest.approx(0.42)
+    assert report["anomalies"] == [{
+        "epoch": 1, "step": 2, "anomaly": "grad_norm_explosion",
+        "value": 7.0, "median": 1.5, "ratio": 4.667,
+    }]
     text = format_text(report)
     assert "run r-1" in text and "1 unparsable line(s)" in text
     assert "straggler: epoch 1 process 3 at 2.1x median" in text
     assert "ckpt.writes+2" in text  # epoch-1 delta line
+    assert "device: grad_norm last 7 / max 7" in text
+    assert "anomaly: epoch 1 step 2 grad_norm_explosion value 7.0" in text
+    assert "mean MFU 0.42" in text
 
 
 def test_summarize_resets_deltas_at_resume_boundary():

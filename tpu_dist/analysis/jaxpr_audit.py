@@ -422,6 +422,15 @@ def _case_zero1_int8(mesh):
     return fn, args, CollectiveBudget(dict(_ZERO1_INT8_BUDGET), bf16_to_f32=None)
 
 
+def _case_dp_device_metrics(mesh):
+    # --device_metrics: the health scalars (grad/param norm, update ratio,
+    # nonfinite count) are computed on the POST-pmean gradients — the
+    # collective budget is IDENTICAL to the plain step's (TD107's
+    # flag-on half, enforced here through the ordinary TD101 machinery)
+    fn, args = _dp_setup(mesh, device_metrics=True)
+    return fn, args, CollectiveBudget(dict(_DP_BUDGET), bf16_to_f32=None)
+
+
 def _fused_budget(per_step: dict) -> dict:
     return {k: v * _FUSED_STEPS for k, v in per_step.items()}
 
@@ -442,6 +451,7 @@ register_audit_case("dp_wire_bf16", _case_dp_wire_bf16)
 register_audit_case("dp_int8", _case_dp_int8)
 register_audit_case("dp_int8_ef", _case_dp_int8_ef)
 register_audit_case("zero1_int8", _case_zero1_int8)
+register_audit_case("dp_device_metrics", _case_dp_device_metrics)
 register_audit_case("fused_none", _case_fused("none", _DP_BUDGET))
 register_audit_case("fused_bf16", _case_fused("bf16", _DP_BUDGET))
 register_audit_case("fused_int8", _case_fused("int8", _DP_INT8_BUDGET))
@@ -621,12 +631,95 @@ def telemetry_noop_violations(mesh=None) -> list[Violation]:
     return []
 
 
+def device_metrics_noop_violations(mesh=None) -> list[Violation]:
+    """TD107: the ``--device_metrics`` cost contract, checked at the
+    program level.
+
+    Flag-off half (the TD105/TD106 pattern — armed host machinery vs a
+    quiet baseline, NOT two identical traces): the baseline traces the
+    default step with nothing armed; then the HOST health layer goes live
+    — the compile-time ``jax.monitoring`` listener installed, an
+    ``AnomalyDetector`` observing values, a ``CompileWatcher`` reading
+    the executable cache — and an explicit ``device_metrics=False`` step
+    is traced under it. The two jaxprs must be byte-identical: anomaly
+    detection, cost capture, and compile accounting are host-side by
+    construction, and the moment someone "optimizes" a threshold or a
+    counter into the traced step, this trips.
+
+    Flag-on half: the pure-DP path's collective AND transfer inventories
+    must be unchanged — the health scalars are computed on the post-pmean
+    gradients and ride the metrics tree the trainer already fetches, so
+    the moment one of them needs its own reduce (or a host transfer),
+    this trips. The fetch-count half of the contract (still exactly one
+    per-step ``device_get``) is a host-loop property, pinned by the
+    trainer-level parity test in ``tests/test_device_health.py``."""
+    import jax
+
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.obs import costmodel
+    from tpu_dist.obs.anomaly import AnomalyDetector
+
+    m = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+    fn, args = _dp_setup(m)
+    base_counts = trace_counts(fn, *args)
+    base = str(jax.make_jaxpr(fn)(*args))
+    # arm the host health layer, then trace the explicit flag-off step
+    costmodel.install_compile_listener()
+    det = AnomalyDetector(window=4)
+    fn_off, args_off = _dp_setup(m, device_metrics=False)
+    watcher = costmodel.CompileWatcher(fn_off)
+    for i in range(6):
+        det.observe(epoch=0, step=i, loss=1.0 + i, grad_norm=0.5)
+        watcher.observe()
+    off = str(jax.make_jaxpr(fn_off)(*args_off))
+    det.observe(epoch=0, step=99, loss=1e9)  # a firing detector, too
+    watcher.observe()
+    out: list[Violation] = []
+    if base != off:
+        out.append(
+            Violation(
+                "TD107",
+                "<jaxpr:dp_device_metrics_noop>",
+                0,
+                "the traced train step with device_metrics=False under an "
+                "armed host health layer (anomaly detector observing, "
+                "compile listener + cache watcher live) differs from the "
+                "quiet default step — the disabled flag plus the host-side "
+                "machinery must be a byte-identical no-op "
+                "(obs/device_stats.py contract)",
+                snippet="jaxpr(device_metrics_off|health armed) != jaxpr(default)",
+            )
+        )
+    fn_on, args_on = _dp_setup(m, device_metrics=True)
+    on_counts = trace_counts(fn_on, *args_on)
+    if (
+        on_counts["collectives"] != base_counts["collectives"]
+        or on_counts["transfers"] != base_counts["transfers"]
+    ):
+        out.append(
+            Violation(
+                "TD107",
+                "<jaxpr:dp_device_metrics_noop>",
+                0,
+                "arming --device_metrics changed the pure-DP step's "
+                f"collective/transfer inventory (off: "
+                f"{base_counts['collectives']}/{base_counts['transfers']} "
+                f"→ on: {on_counts['collectives']}/{on_counts['transfers']})"
+                " — the health scalars must stay local arithmetic on the "
+                "post-pmean gradients",
+                snippet=f"collectives:{on_counts['collectives']}",
+            )
+        )
+    return out
+
+
 def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     """Run every (or the named) registered case. Returns
     ``(report, violations)`` where report maps case → op counts.
     Cross-case TD104 wire-ratio checks run over whichever quantized/
     reference pairs the report contains; full (unfiltered) runs also check
-    the TD105 fault-injection and TD106 telemetry no-op invariants."""
+    the TD105 fault-injection, TD106 telemetry, and TD107 device-metrics
+    no-op invariants."""
     report: dict = {}
     violations: list[Violation] = []
     for name in names if names is not None else registered_cases():
@@ -640,6 +733,9 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
         violations.extend(vs)
         vs = telemetry_noop_violations(mesh)
         report["dp_telemetry_noop"] = {"identical": not vs}
+        violations.extend(vs)
+        vs = device_metrics_noop_violations(mesh)
+        report["dp_device_metrics_noop"] = {"identical": not vs}
         violations.extend(vs)
     return report, violations
 

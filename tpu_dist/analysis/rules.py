@@ -122,6 +122,15 @@ RULES: dict[str, Rule] = {
             "(tpu_dist.obs contract, docs/observability.md)",
         ),
         Rule(
+            "TD107",
+            "device-metrics-cost-leak",
+            "the --device_metrics contract broke: flag OFF must leave the "
+            "traced train step byte-identical, flag ON must add zero "
+            "collectives and zero transfer ops on the pure-DP path (the "
+            "health scalars ride the post-pmean gradients and the "
+            "existing single per-step fetch — obs/device_stats.py)",
+        ),
+        Rule(
             "TD104",
             "quantized-wire-bytes-over-budget",
             "gradient-collective payload bytes of a quantized wire format "

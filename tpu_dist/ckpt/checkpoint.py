@@ -208,6 +208,7 @@ def save(
     epoch: int,
     keep_last: Optional[int] = None,
     extra_meta: Optional[dict] = None,
+    name: Optional[str] = None,
 ) -> Optional[str]:
     """Write ``ckpt_{epoch}.npz``; no-op off process 0 (rank-0 guard).
 
@@ -215,7 +216,11 @@ def save(
     ``extra_meta``: extra JSON-serializable keys for the sidecar (e.g. the
     pipeline layout tag — interleaved storage permutes block order, so a
     resume under a different ``pp_interleave`` must be refused, not run
-    silently wrong)."""
+    silently wrong).
+    ``name`` overrides the file name — an off-namespace name (one the
+    ``ckpt_{N}.npz`` discovery regex cannot match, e.g. the trainer's
+    ``anomaly_*`` forensic snapshots) is never auto-resumed, never
+    pruned, and never overwritten by the periodic saves."""
     # flatten BEFORE the rank-0 guard: gathering cross-process-sharded
     # leaves is collective, so every process must participate
     flat = _flatten(state._asdict())
@@ -224,7 +229,9 @@ def save(
     meta = {"epoch": epoch, "step": int(_scalar_to_host(state.step))}
     if extra_meta:
         meta.update(extra_meta)
-    return _write_npz(ckpt_dir, f"ckpt_{epoch}.npz", flat, meta, keep_last)
+    return _write_npz(
+        ckpt_dir, name or f"ckpt_{epoch}.npz", flat, meta, keep_last
+    )
 
 
 def save_best(

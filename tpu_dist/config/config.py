@@ -108,6 +108,25 @@ class TrainConfig:
                                    # allgathered per-process epoch times
                                    # above which a rank-0 straggler warning
                                    # (+ history record) fires; 0 disables
+    device_metrics: bool = False   # in-step health scalars (global grad
+                                   # norm, param norm, update ratio,
+                                   # nonfinite-leaf count) fused into the
+                                   # traced step post-pmean — zero extra
+                                   # collectives/fetches (TD107;
+                                   # obs/device_stats.py). Replicated-
+                                   # param paths only (no zero1/fsdp/
+                                   # tp/ep/pp/fused_epoch)
+    anomaly_action: str = "warn"   # off | warn | snapshot — response to a
+                                   # rolling-window loss-spike/grad-norm
+                                   # anomaly (obs/anomaly.py): warn logs a
+                                   # rank-0 warning + 'anomaly' history
+                                   # record; snapshot additionally writes
+                                   # an exact mid-epoch checkpoint
+    anomaly_window: int = 50       # rolling-median window (observations at
+                                   # the log cadence)
+    anomaly_loss_spike: float = 3.0   # loss > X * rolling median => anomaly
+    anomaly_grad_spike: float = 10.0  # grad_norm > X * rolling median
+                                   # (needs --device_metrics for the norm)
 
     # -- TPU fast path -------------------------------------------------------
     fused_epoch: bool = False      # device-resident data, one jit per epoch
@@ -334,6 +353,32 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "slowest process's epoch time exceeds X times the "
                         "median across processes (allgathered at epoch "
                         "end); 0 disables")
+    p.add_argument("--device_metrics", action="store_true",
+                   help="compute in-step training-health scalars (global "
+                        "grad norm, param norm, update ratio, nonfinite-"
+                        "leaf count) inside the traced step, post-pmean — "
+                        "zero extra collectives and zero extra per-step "
+                        "fetches (TD107 contract; docs/observability.md). "
+                        "Replicated-param paths only")
+    p.add_argument("--anomaly_action", choices=("off", "warn", "snapshot"),
+                   default=d.anomaly_action,
+                   help="response to a rolling-window loss-spike/grad-norm "
+                        "anomaly: 'warn' (default) logs a rank-0 warning + "
+                        "history record; 'snapshot' additionally writes an "
+                        "exact mid-epoch checkpoint (the emergency-snapshot "
+                        "discipline) before the run can diverge further; "
+                        "'off' disables detection")
+    p.add_argument("--anomaly_window", type=int, default=d.anomaly_window,
+                   metavar="N",
+                   help="rolling-median window of the anomaly detector, in "
+                        "observations at the --log_every cadence")
+    p.add_argument("--anomaly_loss_spike", type=float,
+                   default=d.anomaly_loss_spike, metavar="X",
+                   help="flag a loss above X times the rolling median")
+    p.add_argument("--anomaly_grad_spike", type=float,
+                   default=d.anomaly_grad_spike, metavar="X",
+                   help="flag a grad norm above X times the rolling median "
+                        "(grad norms need --device_metrics)")
     p.add_argument("--eval_every", type=int, default=d.eval_every,
                    help="epochs between evaluations; 0 disables")
     p.add_argument("--save_every", type=int, default=d.save_every)

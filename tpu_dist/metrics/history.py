@@ -4,7 +4,7 @@ in ``utils/config.py:8``). One JSON object per line, append-only, rank-0
 only; consumable by pandas/jq/tensorboard-importers and by
 ``python -m tpu_dist.obs summarize`` (docs/observability.md).
 
-Schema (version 2): every record carries
+Schema (version 3): every record carries
 
 * ``ts`` — wall clock (epoch seconds; for humans and cross-run joins),
 * ``rel_s`` — monotonic seconds since this history opened (immune to NTP
@@ -16,6 +16,12 @@ Schema (version 2): every record carries
 * ``counters`` — a snapshot of the process-global telemetry registry
   (``tpu_dist.obs.counters``), when non-empty; the summarize CLI turns
   successive snapshots into per-epoch deltas.
+
+Version history: v2 added ``rel_s``/``run_id``/``counters``; v3 added the
+device-health layer — ``device_stats`` and ``anomaly`` record kinds and
+the ``mfu`` field on ``train_epoch`` (docs/observability.md). Consumers
+(``obs summarize``/``compare``) read all versions: every addition is a
+new kind or optional field, never a changed one.
 
 The file handle is opened once, line-buffered, and reused — the previous
 open-per-``log()`` implementation paid a file open/close every record and
@@ -33,7 +39,7 @@ import jax
 
 from tpu_dist.obs import counters as counters_lib
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 
 class MetricsHistory:

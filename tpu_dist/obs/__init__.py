@@ -1,17 +1,24 @@
-"""Run telemetry: span tracing, subsystem counters, heartbeat, straggler
-detection, and the offline ``python -m tpu_dist.obs summarize`` CLI.
+"""Run telemetry + device-side training health: span tracing, subsystem
+counters, heartbeat, straggler detection, in-step health scalars
+(``device_stats``), cost/MFU accounting (``costmodel``), anomaly
+detection, and the offline ``python -m tpu_dist.obs summarize`` /
+``compare`` CLI.
 
-Contract (audited by TD106): everything in this package is host-side —
-arming telemetry leaves the traced train step byte-identical and adds no
-per-step device transfers. See ``docs/observability.md``.
+Contract (audited by TD106/TD107): the host-telemetry half is host-side
+only — arming it leaves the traced train step byte-identical and adds no
+per-step device transfers. The one deliberately device-side piece,
+``device_stats`` (opt-in ``--device_metrics``), adds zero collectives and
+rides the existing single per-step metrics fetch. See
+``docs/observability.md``.
 """
 
 from tpu_dist.obs import counters, spans  # noqa: F401
 
 
 def __getattr__(name):
-    # lazy: straggler/heartbeat pull in the (jax-importing) logging layer;
-    # the offline CLI and the loader producer thread only need counters/spans
+    # lazy: straggler/heartbeat/device_stats/costmodel pull in jax or the
+    # (jax-importing) logging layer; the offline CLI and the loader
+    # producer thread only need counters/spans
     if name == "Heartbeat":
         from tpu_dist.obs.heartbeat import Heartbeat
 
@@ -20,4 +27,8 @@ def __getattr__(name):
         from tpu_dist.obs.straggler import epoch_skew
 
         return epoch_skew
+    if name == "AnomalyDetector":
+        from tpu_dist.obs.anomaly import AnomalyDetector
+
+        return AnomalyDetector
     raise AttributeError(f"module 'tpu_dist.obs' has no attribute {name!r}")

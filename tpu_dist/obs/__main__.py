@@ -10,7 +10,15 @@ Subcommands::
         Chrome trace-event JSON (Perfetto / chrome://tracing loadable)
         from the run's drained spans + synthesized epoch/eval bars.
 
-Exit codes: 0 ok, 1 empty/unusable input, 2 bad invocation or I/O error.
+    compare <baseline.jsonl> <candidate.jsonl> [--threshold 0.05]
+            [--bench] [--format text|json]
+        Regression gate: diff throughput, step-time percentiles, stall
+        fraction, MFU, and final metrics between two runs' logs (or, with
+        --bench, two bench.py JSON outputs). Exits 1 on any regression
+        beyond the threshold — wire it into CI.
+
+Exit codes: 0 ok, 1 empty/unusable input (or, for ``compare``, a
+regression), 2 bad invocation or I/O error.
 The analysis itself is pure file crunching — no device, no backend.
 """
 
@@ -35,7 +43,48 @@ def main(argv=None) -> int:
     t = sub.add_parser("export-trace", help="write Chrome trace-event JSON")
     t.add_argument("log", help="JSONL history written by --log_file")
     t.add_argument("-o", "--out", default=None, help="output path (default: <log>.trace.json)")
+    c = sub.add_parser(
+        "compare",
+        help="regression gate: diff two runs' telemetry, exit 1 on regression",
+    )
+    c.add_argument("baseline", help="baseline --log_file JSONL (or bench JSON with --bench)")
+    c.add_argument("candidate", help="candidate --log_file JSONL (or bench JSON with --bench)")
+    c.add_argument(
+        "--threshold", type=float, default=0.05, metavar="FRAC",
+        help="relative regression tolerance (default 0.05 = 5%%); each "
+             "metric adds its own absolute noise slack on top",
+    )
+    c.add_argument(
+        "--bench", action="store_true",
+        help="inputs are bench.py JSON outputs (one object per line), "
+             "matched by their 'metric' name",
+    )
+    c.add_argument("--format", choices=("text", "json"), default="text")
     args = ap.parse_args(argv)
+
+    if args.cmd == "compare":
+        from tpu_dist.obs import compare as compare_lib
+
+        try:
+            result = compare_lib.compare_files(
+                args.baseline, args.candidate,
+                threshold=args.threshold, bench=args.bench,
+            )
+        except (OSError, ValueError) as e:
+            print(f"tpu_dist.obs: compare failed: {e}", file=sys.stderr)
+            return 2
+        if args.format == "json":
+            print(json.dumps(result, indent=2))
+        else:
+            print(compare_lib.format_text(result))
+        if result["compared"] == 0:
+            # a gate that compared nothing must not pass silently
+            print(
+                "tpu_dist.obs: no comparable metrics between the two "
+                "inputs", file=sys.stderr,
+            )
+            return 2
+        return 1 if result["regressions"] else 0
 
     try:
         records, bad = summ.load_records(args.log)

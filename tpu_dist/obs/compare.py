@@ -1,0 +1,239 @@
+"""Run-compare regression gate — ``python -m tpu_dist.obs compare``.
+
+Diffs two runs' telemetry and exits nonzero on a regression, so CI can
+gate a change on measured training health instead of an eyeballed JSON
+diff. Two input modes:
+
+* **history mode** (default): both inputs are ``--log_file`` JSONLs; each
+  is folded through :func:`tpu_dist.obs.summarize.summarize` and the
+  comparison runs over the derived scalars — mean throughput, step-time
+  p50/p95/p99, data-stall fraction, mean MFU, final train loss, final
+  val top-1.
+* **bench mode** (``--bench``): both inputs are ``bench.py`` output files
+  (one JSON object per line, ``BENCH_*.json``); records are matched by
+  their ``metric`` name and compared on throughput / step-time /
+  sec-per-epoch / MFU.
+
+A metric regresses when the candidate is worse than the baseline by more
+than ``threshold`` (relative, default 5%) plus the metric's absolute
+slack (noise floor — stall fraction and MFU move in absolute points on
+quiet runs, a pure ratio would flag 0.1% vs 0.2% stall as a 2× blowup).
+Better-than-baseline is never flagged, metrics missing from either side
+are reported as skipped (never silently dropped), and a self-compare is
+zero regressions by construction.
+
+Pure host-side file crunching: no jax, runs anywhere the package imports.
+All output formatting returns strings — printing (and the exit code)
+belongs to ``obs/__main__.py``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Tuple
+
+from tpu_dist.obs import summarize as summ
+
+#: history-mode metrics: (key, direction, absolute slack). Direction is
+#: which way is BETTER; slack is added to the relative allowance.
+REPORT_METRICS: Tuple[Tuple[str, str, float], ...] = (
+    ("images_per_sec_mean", "higher", 0.0),
+    ("step_time_p50_s", "lower", 0.0),
+    ("step_time_p95_s", "lower", 0.0),
+    ("step_time_p99_s", "lower", 0.0),
+    ("data_stall_frac", "lower", 0.02),
+    ("mfu_mean", "higher", 0.005),
+    ("final_loss", "lower", 0.02),
+    ("final_val_top1", "higher", 0.5),
+)
+
+#: bench-mode per-record fields: (field, direction, absolute slack).
+BENCH_FIELDS: Tuple[Tuple[str, str, float], ...] = (
+    ("value", "higher", 0.0),          # images/sec (or tokens/sec)
+    ("sec_per_epoch", "lower", 0.0),
+    ("step_ms", "lower", 0.0),
+    ("step_ms_p50", "lower", 0.0),
+    ("step_ms_p95", "lower", 0.0),
+    ("step_ms_p99", "lower", 0.0),
+    ("mfu", "higher", 0.005),
+)
+
+
+def _mean(vals: List) -> Optional[float]:
+    nums = [v for v in vals if isinstance(v, (int, float))]
+    return sum(nums) / len(nums) if nums else None
+
+
+def report_scalars(report: dict) -> dict:
+    """Flatten a :func:`summarize` report into the comparable scalars."""
+    epochs = report.get("epochs", [])
+    losses = [r.get("loss") for r in epochs if isinstance(r.get("loss"), (int, float))]
+    top1s = [
+        r.get("val_top1") for r in epochs
+        if isinstance(r.get("val_top1"), (int, float))
+    ]
+    return {
+        "images_per_sec_mean": report["totals"].get("images_per_sec_mean"),
+        "step_time_p50_s": _mean([r.get("step_time_p50_s") for r in epochs]),
+        "step_time_p95_s": _mean([r.get("step_time_p95_s") for r in epochs]),
+        "step_time_p99_s": _mean([r.get("step_time_p99_s") for r in epochs]),
+        "data_stall_frac": _mean([r.get("data_stall_frac") for r in epochs]),
+        "mfu_mean": report["totals"].get("mfu_mean"),
+        "final_loss": losses[-1] if losses else None,
+        "final_val_top1": top1s[-1] if top1s else None,
+    }
+
+
+def _row(
+    metric: str, direction: str, slack: float,
+    base, cand, threshold: float,
+) -> dict:
+    if not isinstance(base, (int, float)) or not isinstance(cand, (int, float)):
+        return {"metric": metric, "baseline": base, "candidate": cand,
+                "verdict": "skipped"}
+    worse_by = (base - cand) if direction == "higher" else (cand - base)
+    allowed = abs(base) * threshold + slack
+    regressed = worse_by > allowed
+    out = {
+        "metric": metric,
+        "baseline": base,
+        "candidate": cand,
+        "delta": round(cand - base, 6),
+        "verdict": "REGRESSED" if regressed else "ok",
+    }
+    if base:
+        out["delta_frac"] = round((cand - base) / abs(base), 4)
+    return out
+
+
+def compare_scalars(base: dict, cand: dict, threshold: float = 0.05) -> dict:
+    rows = [
+        _row(key, direction, slack, base.get(key), cand.get(key), threshold)
+        for key, direction, slack in REPORT_METRICS
+    ]
+    return _result(rows, threshold)
+
+
+def _result(rows: List[dict], threshold: float) -> dict:
+    return {
+        "threshold": threshold,
+        "rows": rows,
+        "regressions": sum(r["verdict"] == "REGRESSED" for r in rows),
+        "compared": sum(r["verdict"] != "skipped" for r in rows),
+        "skipped": sum(r["verdict"] == "skipped" for r in rows),
+    }
+
+
+# -- input loading -----------------------------------------------------------
+
+
+def load_history_scalars(path: str) -> dict:
+    """``--log_file`` JSONL → comparable scalars; raises ValueError on an
+    empty/unusable file (a gate comparing nothing must fail loudly)."""
+    records, _bad = summ.load_records(path)
+    if not records:
+        raise ValueError(f"no records in {path}")
+    report = summ.summarize(records)
+    if not report["epochs"]:
+        raise ValueError(f"no train_epoch records in {path}")
+    scalars = report_scalars(report)
+    scalars["_run_id"] = report.get("run_id")
+    return scalars
+
+
+def load_bench_records(path: str) -> dict:
+    """bench.py output (JSON object per line) → ``{metric_name: record}``.
+    Tolerates a torn tail like the history loader; raises ValueError when
+    nothing parses."""
+    out = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and rec.get("metric"):
+                out[rec["metric"]] = rec
+    if not out:
+        raise ValueError(f"no bench records in {path}")
+    return out
+
+
+def compare_bench(base: dict, cand: dict, threshold: float = 0.05) -> dict:
+    """Compare two ``{metric: record}`` bench maps field-by-field; metrics
+    present on only one side are reported as skipped rows."""
+    rows: List[dict] = []
+    for name in sorted(set(base) | set(cand)):
+        b, c = base.get(name), cand.get(name)
+        if b is None or c is None:
+            rows.append({
+                "metric": name,
+                "baseline": None if b is None else "present",
+                "candidate": None if c is None else "present",
+                "verdict": "skipped",
+            })
+            continue
+        for field, direction, slack in BENCH_FIELDS:
+            if field not in b and field not in c:
+                continue
+            rows.append(_row(
+                f"{name}.{field}", direction, slack,
+                b.get(field), c.get(field), threshold,
+            ))
+    return _result(rows, threshold)
+
+
+def compare_files(
+    baseline: str, candidate: str, *,
+    threshold: float = 0.05, bench: bool = False,
+) -> dict:
+    """The CLI engine: load both inputs and diff. Raises OSError on an
+    unreadable file and ValueError on an unusable one — the caller maps
+    both to exit 2 (a broken gate, distinct from exit 1's regression)."""
+    if bench:
+        result = compare_bench(
+            load_bench_records(baseline), load_bench_records(candidate),
+            threshold,
+        )
+    else:
+        b = load_history_scalars(baseline)
+        c = load_history_scalars(candidate)
+        result = compare_scalars(b, c, threshold)
+        result["baseline_run_id"] = b.get("_run_id")
+        result["candidate_run_id"] = c.get("_run_id")
+    result["baseline"] = baseline
+    result["candidate"] = candidate
+    return result
+
+
+def format_text(result: dict) -> str:
+    lines = [
+        f"compare: baseline {result['baseline']} vs candidate "
+        f"{result['candidate']} (threshold {result['threshold'] * 100:g}%)"
+    ]
+    w = max([len(r["metric"]) for r in result["rows"]] + [6])
+
+    def cell(v):
+        if isinstance(v, float):
+            return format(v, ".6g").rjust(12)
+        return str(v if v is not None else "-").rjust(12)
+
+    lines.append(f"  {'metric'.ljust(w)} {'baseline':>12} {'candidate':>12} "
+                 f"{'delta%':>8}  verdict")
+    for r in result["rows"]:
+        frac = r.get("delta_frac")
+        lines.append(
+            f"  {r['metric'].ljust(w)} {cell(r.get('baseline'))} "
+            f"{cell(r.get('candidate'))} "
+            f"{(format(frac * 100, '+.1f') if frac is not None else '-'):>8}"
+            f"  {r['verdict']}"
+        )
+    lines.append(
+        f"compare: {result['regressions']} regression(s) over "
+        f"{result['compared']} compared metric(s)"
+        + (f", {result['skipped']} skipped" if result["skipped"] else "")
+    )
+    return "\n".join(lines)

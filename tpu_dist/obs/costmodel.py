@@ -1,0 +1,252 @@
+"""Device cost & efficiency accounting — flops, bytes, peak HBM, MFU.
+
+ONE home for reading XLA's cost/memory analysis out of a train step and
+turning it into efficiency numbers, shared by the trainer (first-dispatch
+gauges + the per-epoch MFU in every summary/history record) and
+``bench.py`` (which previously kept its own private copy of the chip-peak
+table and the cost-analysis plumbing).
+
+Everything here is host-side: ``Lowered.cost_analysis()`` runs XLA's
+``HloCostAnalysis`` over the traced module without compiling or touching a
+device, ``Compiled.cost_analysis()``/``memory_analysis()`` read numbers
+XLA already produced while compiling, and :func:`device_memory_stats`
+reads the allocator's live counters. Arming any of it adds zero device
+work — the TD106/TD107 jaxpr gates pin that.
+
+MFU methodology (``docs/observability.md``): the numerator is the total
+FLOPs XLA counts in ONE compiled step (the real fwd+bwd+update HLO, not an
+analytic guess — inner ``scan`` bodies are counted once, so callers pass
+``loop_trips`` for grad-accumulation/fused-epoch loops); the denominator
+is wall seconds per step × the aggregate peak dense-matmul FLOP/s of the
+visible chips (:data:`CHIP_PEAK_FLOPS`, public spec-sheet bf16 numbers).
+Unknown chip kinds — including CPU emulation — yield ``mfu=None`` rather
+than a made-up figure.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpu_dist.obs import counters as counters_lib
+
+# Peak dense matmul FLOP/s per chip (bf16), the MFU denominator. Public
+# spec-sheet numbers; longest-prefix matched against ``device_kind``.
+CHIP_PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def chip_peak_flops(kind: Optional[str] = None) -> Optional[float]:
+    """Peak FLOP/s for ``kind`` (default: the first visible device's
+    ``device_kind``); None for unknown kinds — CPU emulation above all."""
+    if kind is None:
+        import jax  # noqa: PLC0415
+
+        kind = jax.devices()[0].device_kind
+    for name, peak in sorted(CHIP_PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+        if kind.startswith(name):
+            return peak
+    return None
+
+
+def _cost_dict(obj) -> dict:
+    """``cost_analysis()`` of a Lowered/Compiled, normalized to one dict
+    (older jax returns a one-element list per device)."""
+    ca = obj.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca) if ca else {}
+
+
+def step_cost(obj, loop_trips: int = 1) -> dict:
+    """``{"flops_per_step", "bytes_per_step"}`` of one compiled/lowered
+    step (either may be None when XLA reports nothing useful).
+
+    ``loop_trips``: XLA counts a while/scan body ONCE, so steps built
+    around an inner loop (grad-accumulation scan, fused-epoch step scan)
+    pass the trip count; the body dominates the program, so multiplying
+    the whole count errs by at most the loop-external ops (a few %,
+    overestimating trips-1 copies of them)."""
+    try:
+        ca = _cost_dict(obj)
+    except Exception:
+        return {"flops_per_step": None, "bytes_per_step": None}
+
+    def scaled(key):
+        v = ca.get(key)
+        return float(v) * loop_trips if v and v > 0 else None
+
+    return {
+        "flops_per_step": scaled("flops"),
+        "bytes_per_step": scaled("bytes accessed"),
+    }
+
+
+def mfu(
+    flops_per_step: Optional[float],
+    step_seconds: float,
+    n_devices: int,
+    peak: Optional[float] = None,
+) -> Optional[float]:
+    """Model FLOPs utilization: achieved FLOP/s over aggregate chip peak.
+    ``peak`` overrides the per-chip table lookup (tests, exotic parts)."""
+    if peak is None:
+        peak = chip_peak_flops()
+    if flops_per_step is None or peak is None or step_seconds <= 0:
+        return None
+    return round(flops_per_step / step_seconds / (peak * n_devices), 4)
+
+
+def memory_analysis_bytes(compiled) -> Optional[dict]:
+    """Peak-HBM estimate from a Compiled's ``memory_analysis()``: XLA's
+    own accounting of argument/output/temp/code bytes for the executable
+    (``peak_bytes`` = their sum less buffer aliasing). None when the
+    backend does not implement it."""
+    try:
+        ma = compiled.memory_analysis()
+        arg = int(getattr(ma, "argument_size_in_bytes", 0) or 0)
+        out = int(getattr(ma, "output_size_in_bytes", 0) or 0)
+        tmp = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+        code = int(getattr(ma, "generated_code_size_in_bytes", 0) or 0)
+        alias = int(getattr(ma, "alias_size_in_bytes", 0) or 0)
+    except Exception:
+        return None
+    return {
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": tmp,
+        "generated_code_bytes": code,
+        "peak_bytes": max(arg + out + tmp + code - alias, 0),
+    }
+
+
+def device_memory_stats() -> Optional[dict]:
+    """Live allocator counters of the first local device
+    (``bytes_in_use`` / ``peak_bytes_in_use``) — the TRUE peak-HBM gauge
+    on TPU/GPU, updated by the runtime itself. None where the backend
+    keeps no stats (CPU)."""
+    try:
+        import jax  # noqa: PLC0415
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    out = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        v = stats.get(key)
+        if isinstance(v, (int, float)):
+            out[key] = int(v)
+    return out or None
+
+
+def analyze_jitted(jitted, *args, loop_trips: int = 1) -> Optional[dict]:
+    """Cost-analyze a ``jax.jit``-wrapped step WITHOUT compiling it twice:
+    ``jitted.lower(*args)`` re-traces abstractly (host-only, no device
+    dispatch, no XLA compile) and ``Lowered.cost_analysis()`` runs the HLO
+    cost model over the traced module. Returns :func:`step_cost`'s dict,
+    or None when lowering/analysis is unavailable — callers degrade to
+    "no MFU", never to an error."""
+    try:
+        lowered = jitted.lower(*args)
+    except Exception:
+        return None
+    return step_cost(lowered, loop_trips)
+
+
+class CompileWatcher:
+    """Turn a jitted step's executable-cache growth into compile telemetry.
+
+    jax keeps one compiled executable per (shape, dtype, static-arg)
+    signature; the cache growing past the first entry mid-run means the
+    step RETRACED — usually shape/dtype drift in the input pipeline, and
+    on a pod each retrace is a full XLA compile stall on every host. The
+    trainer calls :meth:`observe` once per step (one C++ attribute read —
+    no device work, no sync): every growth increments ``compile.events``,
+    growth after the first dispatch additionally increments
+    ``compile.retraces`` and returns True so the caller can warn on
+    rank 0. ``obs summarize`` surfaces the per-epoch retrace delta.
+
+    Degrades to a permanent no-op when the callable has no
+    ``_cache_size`` (a non-jit wrapper, or a jax that dropped the
+    private API) — observation must never break the step loop."""
+
+    def __init__(self, jitted):
+        self._size_fn = getattr(jitted, "_cache_size", None)
+        self._seen = 0
+
+    def observe(self) -> bool:
+        """Record any new compiles; True when one was a mid-run retrace."""
+        if self._size_fn is None:
+            return False
+        try:
+            size = int(self._size_fn())
+        except Exception:
+            self._size_fn = None
+            return False
+        if size <= self._seen:
+            return False
+        grew, first = size - self._seen, self._seen == 0
+        self._seen = size
+        counters_lib.inc("compile.events", grew)
+        retraces = grew - 1 if first else grew
+        if retraces > 0:
+            counters_lib.inc("compile.retraces", retraces)
+            return True
+        return False
+
+
+_LISTENER_INSTALLED = False
+
+
+def install_compile_listener() -> bool:
+    """Accumulate XLA's own backend-compile wall time into the
+    ``compile.seconds`` counter via ``jax.monitoring`` (fires for every
+    compile in the process — train step, eval step, fused paths alike).
+    Idempotent; jax offers no unregistration, so ONE process-lifetime
+    listener feeds the process-global counter registry. Returns whether
+    the listener is (now) installed; False on a jax without the API."""
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return True
+    try:
+        from jax import monitoring  # noqa: PLC0415
+
+        def _on_event(event: str, duration: float, **kw) -> None:
+            # backend_compile ONLY: one jit compile also fires nested
+            # jaxpr_trace / jaxpr_to_mlir_module duration events whose
+            # wall times overlap it — summing every "compile"-ish event
+            # would over-count real elapsed time severalfold
+            if "backend_compile" in event:
+                counters_lib.inc("compile.seconds", round(float(duration), 3))
+
+        monitoring.register_event_duration_secs_listener(_on_event)
+    except Exception:
+        return False
+    _LISTENER_INSTALLED = True
+    return True
+
+
+def publish(cost: Optional[dict]) -> None:
+    """Stamp a step-cost dict into the telemetry gauges
+    (``device.flops_per_step`` / ``device.bytes_per_step``) so every
+    history record carries the numbers next to the throughput they
+    explain."""
+    if not cost:
+        return
+    for key, gauge in (
+        ("flops_per_step", "device.flops_per_step"),
+        ("bytes_per_step", "device.bytes_per_step"),
+    ):
+        v = cost.get(key)
+        if v is not None:
+            counters_lib.set_gauge(gauge, v)

@@ -1,0 +1,76 @@
+"""In-step training-health scalars — the device half of ``--device_metrics``.
+
+:func:`compute_device_stats` runs INSIDE the traced train step
+(``train/step.py``), on the POST-reduce gradients: after the data-parallel
+``pmean`` (or the quantized two-stage reduce) the gradient tree is
+replica-identical, the params are replicated, and every statistic below is
+plain local arithmetic — **zero extra collectives** on the pure-DP path,
+and the resulting scalars ride the metrics dict the trainer already
+fetches with its single per-step ``jax.device_get``. The jaxpr-audit rule
+**TD107** pins both halves of that contract: flag off ⇒ byte-identical
+jaxpr, flag on ⇒ collective/transfer counts unchanged.
+
+The four scalars answer the "is this run healthy?" questions the loss
+curve alone cannot (MLPerf-style pod-scaling practice):
+
+* ``grad_norm`` — global L2 norm of the reduced (post-clip) gradient: the
+  divergence leading indicator; feeds the rolling-window explosion
+  detector (``obs/anomaly.py``).
+* ``param_norm`` — global L2 norm of the parameters: slow drift context
+  for the two ratios.
+* ``update_ratio`` — ‖Δparams‖/‖params‖ for this step (the applied
+  update, so LR schedule, clipping, and weight decay are all reflected):
+  healthy training sits around 1e-3; ~1 means the step is rewriting the
+  network, ~1e-7 means nothing is learning.
+* ``nonfinite_grads`` — number of gradient LEAVES containing any
+  non-finite element: localizes a NaN to a parameter group one step
+  before the loss itself goes NaN (composes with the trainer's NaN
+  guard, which still owns the raise).
+
+Scoped to the replicated-param paths (plain DP/SP, any
+``grad_compression``): under ZeRO-1/FSDP/TP/EP/PP the reduced gradient
+exists only as shards and the global norms would need the extra
+collectives TD107 forbids — ``make_train_step`` refuses the combination.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _sq_sum(tree) -> jnp.ndarray:
+    """f32 sum of squares over every leaf of ``tree`` (0.0 for an empty
+    tree, so degenerate param trees stay well-defined)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+
+
+def compute_device_stats(grads, params, new_params, *, eps: float = 1e-12) -> dict:
+    """The ``--device_metrics`` scalar dict (see module docstring).
+
+    ``grads`` must be the POST-reduce (and post-clip — the stats describe
+    what was applied) gradient tree; ``params``/``new_params`` the
+    parameter tree before/after the optimizer update. Every output is an
+    f32 scalar, replica-identical by construction on the replicated-param
+    paths."""
+    param_norm = jnp.sqrt(_sq_sum(params))
+    update_sq = _sq_sum(
+        jax.tree_util.tree_map(
+            lambda n, p: n.astype(jnp.float32) - p.astype(jnp.float32),
+            new_params,
+            params,
+        )
+    )
+    nonfinite = sum(
+        jnp.any(~jnp.isfinite(g)).astype(jnp.float32)
+        for g in jax.tree_util.tree_leaves(grads)
+    )
+    return {
+        "grad_norm": jnp.sqrt(_sq_sum(grads)),
+        "param_norm": param_norm,
+        "update_ratio": jnp.sqrt(update_sq) / jnp.maximum(param_norm, eps),
+        "nonfinite_grads": nonfinite,
+    }

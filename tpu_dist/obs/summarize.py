@@ -5,10 +5,14 @@ Pure host-side file crunching: this module itself never touches jax, so
 the report runs anywhere the package imports (a laptop holding a pod
 run's log). Input is the :class:`MetricsHistory` JSONL
 schema (``docs/observability.md``): one object per line, ``kind`` keyed —
-``train_epoch`` (throughput, step-time percentiles, stall fraction, a
-counter-registry snapshot), ``eval``, ``straggler``, ``spans`` (drained
+``train_epoch`` (throughput, step-time percentiles, stall fraction, MFU,
+a counter-registry snapshot), ``eval``, ``straggler``, ``device_stats``
+(the per-step ``--device_metrics`` scalars, aggregated per epoch here),
+``anomaly`` (loss-spike / grad-explosion findings), ``spans`` (drained
 Chrome trace events), ``auto_recover``. A torn trailing line (the process
-died mid-write) is tolerated and reported, not fatal.
+died mid-write) is tolerated and reported, not fatal. The regression-gate
+half of the CLI (``compare``) lives in ``obs/compare.py`` and consumes
+:func:`summarize`'s report.
 """
 
 from __future__ import annotations
@@ -42,11 +46,13 @@ def load_records(path: str) -> Tuple[List[dict], int]:
 
 def summarize(records: List[dict], bad_lines: int = 0) -> dict:
     """The per-epoch report: throughput, step-time percentiles, data-stall
-    fraction, counter deltas (vs the previous epoch's snapshot), eval and
-    straggler results merged in by epoch."""
+    fraction, MFU, counter deltas (vs the previous epoch's snapshot), eval,
+    device-stats, anomaly, and straggler results merged in by epoch."""
     epochs: List[dict] = []
     evals = {}
     stragglers = []
+    anomalies: List[dict] = []
+    dstats: dict = {}  # epoch -> per-epoch device_stats aggregate
     recoveries = 0
     prev_counters: Optional[dict] = None
     prev_run_id = None
@@ -69,6 +75,25 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
             stragglers.append(
                 {k: rec.get(k) for k in ("epoch", "skew", "worst_rank", "max_s", "median_s")}
             )
+        elif kind == "anomaly":
+            anomalies.append({
+                k: rec.get(k)
+                for k in ("epoch", "step", "anomaly", "value", "median", "ratio")
+            })
+        elif kind == "device_stats":
+            # per-epoch rollup of the per-step scalars: last value tracks
+            # where the run ended up, max grad_norm catches the spike the
+            # last sample may have missed
+            d = dstats.setdefault(rec.get("epoch"), {"samples": 0})
+            d["samples"] += 1
+            g = rec.get("grad_norm")
+            if isinstance(g, (int, float)):
+                d["grad_norm_last"] = g
+                d["grad_norm_max"] = max(d.get("grad_norm_max", g), g)
+            for key in ("update_ratio", "param_norm"):
+                v = rec.get(key)
+                if isinstance(v, (int, float)):
+                    d[f"{key}_last"] = v
         elif kind == "auto_recover":
             recoveries += 1
         if isinstance(rec.get("counters"), dict):
@@ -85,29 +110,52 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
             "step_time_p99_s": rec.get("step_time_p99"),
             "data_stall_frac": rec.get("data_stall_frac"),
             "loss": rec.get("loss"),
+            "mfu": rec.get("mfu"),
         }
         if cur_counters is not None:
-            row["counter_deltas"] = counters_lib.delta(prev_counters, cur_counters)
+            deltas = counters_lib.delta(prev_counters, cur_counters)
+            row["counter_deltas"] = deltas
+            # mid-run retraces are a first-class health signal, not just a
+            # counter line: surface the per-epoch delta explicitly
+            if deltas.get("compile.retraces"):
+                row["retraces"] = deltas["compile.retraces"]
             prev_counters = cur_counters
         epochs.append(row)
+    attached = set()
     for row in epochs:
         ev = evals.get(row["epoch"])
         if ev is not None:
             row["val_top1"] = ev.get("top1")
+        ds = dstats.get(row["epoch"])
+        if ds is not None:
+            row["device_stats"] = ds
+            attached.add(row["epoch"])
+    # device_stats of epochs with NO train_epoch record — the run died
+    # mid-epoch (exactly the torn-tail case this report tolerates), and
+    # the health data explaining the crash must not vanish with it
+    partial = [
+        {"epoch": e, **d}
+        for e, d in sorted(dstats.items(), key=lambda kv: (kv[0] is None, kv[0]))
+        if e not in attached
+    ]
     times = [r["epoch_time_s"] for r in epochs if r.get("epoch_time_s")]
     ips = [r["images_per_sec"] for r in epochs if r.get("images_per_sec")]
+    mfus = [r["mfu"] for r in epochs if isinstance(r.get("mfu"), (int, float))]
     out = {
         "run_id": run_id,
         "schema_version": schema,
         "n_records": len(records),
         "bad_lines": bad_lines,
         "epochs": epochs,
+        "partial_epoch_device_stats": partial,
         "stragglers": stragglers,
+        "anomalies": anomalies,
         "auto_recoveries": recoveries,
         "totals": {
             "n_epochs": len(epochs),
             "total_train_time_s": round(sum(times), 3) if times else 0.0,
             "images_per_sec_mean": round(sum(ips) / len(ips), 1) if ips else None,
+            "mfu_mean": round(sum(mfus) / len(mfus), 4) if mfus else None,
             "counters": final_counters or {},
         },
     }
@@ -129,7 +177,8 @@ def format_text(report: dict) -> str:
     )
     hdr = (
         f"{'epoch':>5} {'img/s':>9} {'epoch_s':>8} {'p50_ms':>8} "
-        f"{'p95_ms':>8} {'p99_ms':>8} {'stall%':>7} {'loss':>9} {'val_top1':>9}"
+        f"{'p95_ms':>8} {'p99_ms':>8} {'stall%':>7} {'mfu':>6} "
+        f"{'loss':>9} {'val_top1':>9}"
     )
     lines.append(hdr)
     for r in report["epochs"]:
@@ -139,12 +188,48 @@ def format_text(report: dict) -> str:
             f"{_fmt(r['epoch_time_s'], '.2f', 8)} {_fmt(ms(r['step_time_p50_s']), '.1f', 8)} "
             f"{_fmt(ms(r['step_time_p95_s']), '.1f', 8)} {_fmt(ms(r['step_time_p99_s']), '.1f', 8)} "
             f"{_fmt(r['data_stall_frac'] * 100 if r['data_stall_frac'] is not None else None, '.1f', 7)} "
+            f"{_fmt(r.get('mfu'), '.3f', 6)} "
             f"{_fmt(r['loss'], '.4f', 9)} {_fmt(r.get('val_top1'), '.2f', 9)}"
         )
+        ds = r.get("device_stats")
+        if ds:
+            lines.append(
+                "      device: grad_norm last "
+                f"{_fmt(ds.get('grad_norm_last'), '.4g', 0).strip()} / max "
+                f"{_fmt(ds.get('grad_norm_max'), '.4g', 0).strip()}, "
+                "update_ratio "
+                f"{_fmt(ds.get('update_ratio_last'), '.3g', 0).strip()} "
+                f"({ds['samples']} sample(s))"
+            )
+        if r.get("retraces"):
+            lines.append(
+                f"      WARNING: {r['retraces']:g} mid-run retrace(s) — the "
+                "train step recompiled after step 0 (shape/dtype drift)"
+            )
         deltas = r.get("counter_deltas") or {}
         if deltas:
             body = ", ".join(f"{k}+{v:g}" for k, v in sorted(deltas.items()))
             lines.append(f"      counters: {body}")
+    for ds in report.get("partial_epoch_device_stats", []):
+        lines.append(
+            f"partial epoch {ds.get('epoch')} (no epoch summary — run died "
+            "mid-epoch): grad_norm last "
+            f"{_fmt(ds.get('grad_norm_last'), '.4g', 0).strip()} / max "
+            f"{_fmt(ds.get('grad_norm_max'), '.4g', 0).strip()}, "
+            "update_ratio "
+            f"{_fmt(ds.get('update_ratio_last'), '.3g', 0).strip()} "
+            f"({ds.get('samples')} sample(s))"
+        )
+    for a in report.get("anomalies", []):
+        lines.append(
+            f"anomaly: epoch {a.get('epoch')} step {a.get('step')} "
+            f"{a.get('anomaly')} value {a.get('value')}"
+            + (
+                f" ({a.get('ratio')}x rolling median {a.get('median')})"
+                if a.get("ratio") is not None
+                else ""
+            )
+        )
     for s in report["stragglers"]:
         lines.append(
             f"straggler: epoch {s.get('epoch')} process {s.get('worst_rank')} "
@@ -156,6 +241,7 @@ def format_text(report: dict) -> str:
     lines.append(
         f"total: {t['total_train_time_s']}s train"
         + (f", mean {t['images_per_sec_mean']} img/s" if t["images_per_sec_mean"] else "")
+        + (f", mean MFU {t['mfu_mean']}" if t.get("mfu_mean") else "")
     )
     cnt = t.get("counters") or {}
     if cnt:

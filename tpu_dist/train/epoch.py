@@ -69,6 +69,15 @@ def put_dataset_on_device(mesh: Mesh, images_u8: np.ndarray, labels: np.ndarray)
     )
 
 
+def fused_steps_per_epoch(dataset_len: int, global_batch: int) -> int:
+    """Scan trips one fused-epoch call runs (floor division — the runner
+    drops the ragged tail batch). This is the ``loop_trips`` the cost
+    model needs to normalize the fused program's numbers to one step:
+    XLA's cost analysis counts the scan body ONCE, so flops/bytes of the
+    whole-epoch program are body × trips (``obs/costmodel.py``)."""
+    return max(1, int(dataset_len) // int(global_batch))
+
+
 def make_fused_epoch(
     model_apply: Callable,
     optimizer,

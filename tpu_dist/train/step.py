@@ -272,6 +272,7 @@ def make_train_step(
     remat: bool = False,
     grad_compression: str = "none",
     quant_chunk: int | None = None,
+    device_metrics: bool = False,
     model_kwargs: dict | None = None,
 ):
     """Build ``step(state, images, labels, lr) -> (state, metrics)``.
@@ -321,6 +322,18 @@ def make_train_step(
     param all-gather stays in the param dtype — it carries weights, not
     gradients); the model-parallel reduces (tp/ep/pp/sp) are refused, and
     the FSDP engine's GSPMD collectives remain unhookable.
+
+    ``device_metrics=True``: fuse the training-health scalars
+    (``obs/device_stats.py`` — global grad norm, param norm, update
+    ratio, nonfinite-leaf count) into the step's metrics dict. Computed
+    on the POST-reduce gradients, so everything is local arithmetic:
+    zero extra collectives, zero extra host fetches (the scalars ride the
+    metrics tree the trainer already fetches once per logged step) — the
+    TD107 jaxpr rule pins both halves, and flag-off is byte-identical.
+    Scoped to the replicated-param paths (plain DP/SP, any
+    ``grad_compression``, grad accumulation): under ZeRO-1/tp/ep/pp the
+    reduced gradient exists only as shards, so the global norms would
+    need extra collectives — refused rather than silently costed.
     """
     K = int(grad_accum_steps)
     n_axis = int(mesh.shape[axis])
@@ -341,6 +354,21 @@ def make_train_step(
             "data-parallel and ZeRO-1 paths; it cannot combine with "
             "sp/tp/ep/pp (use grad_compression='bf16' there)"
         )
+    if device_metrics and (
+        shard_weight_update
+        or any(a is not None for a in (tp_axis, ep_axis, pp_axis))
+    ):
+        # the health scalars are free only where the reduced grad tree and
+        # the params are replica-identical; under ZeRO-1/tp/ep/pp they
+        # exist as shards and the global norms would need collectives the
+        # TD107 zero-cost contract forbids
+        raise ValueError(
+            "device_metrics is scoped to the replicated-param paths "
+            "(plain DP/SP, any grad_compression) — it cannot combine "
+            "with shard_weight_update/tp/ep/pp"
+        )
+    if device_metrics:
+        from tpu_dist.obs.device_stats import compute_device_stats  # noqa: PLC0415
 
     def wire(g):
         return grad_wire(g, grad_compression)
@@ -561,6 +589,13 @@ def make_train_step(
             "acc1": lax.psum(c1, batch_axes) / (b * lax.psum(1, batch_axes)) * 100.0,
             "acc5": lax.psum(c5, batch_axes) / (b * lax.psum(1, batch_axes)) * 100.0,
         }
+        if device_metrics:
+            # grads is the post-reduce (post-clip) tree here — the ZeRO-1
+            # branch (where it would be a shard) is refused above — so
+            # every stat is local arithmetic riding the same fetch
+            metrics.update(
+                compute_device_stats(grads, state.params, new_params)
+            )
         return new_state, metrics
 
     def _ep_grad_reduce(grads):

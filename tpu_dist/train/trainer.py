@@ -46,6 +46,7 @@ from tpu_dist.evaluation import validate
 from tpu_dist.metrics import AverageMeter, rank0_print
 from tpu_dist.metrics.profiler import StepTimer
 from tpu_dist.nn import resnet18, resnet34, resnet50
+from tpu_dist.obs import costmodel as costmodel_lib
 from tpu_dist.obs import counters as counters_lib
 from tpu_dist.obs import spans as spans_lib
 from tpu_dist.resilience import faults, preemption
@@ -135,6 +136,9 @@ class Trainer:
         # under its fresh run_id — and so the restore ladder's counters
         # (which run during THIS construction, below) attribute to this run
         counters_lib.reset()
+        # process-lifetime XLA compile-time accounting (compile.seconds):
+        # idempotent, host-side, feeds the registry just reset above
+        costmodel_lib.install_compile_listener()
         if cfg.compile_cache_dir:
             # persistent XLA compile cache (VERDICT r1 #8): a rerun of the
             # same config loads compiled programs instead of recompiling
@@ -656,6 +660,49 @@ class Trainer:
                 "--fused_epoch compiles the whole epoch into one call "
                 "(no step boundary to snapshot at)"
             )
+        if cfg.device_metrics:
+            # same wall as make_train_step, caught at the config layer,
+            # plus the two engine exclusions only the trainer knows about
+            if (
+                cfg.fsdp or cfg.shard_weight_update
+                or cfg.tp > 1 or cfg.ep > 1 or cfg.pp > 1
+            ):
+                raise ValueError(
+                    "--device_metrics is scoped to the replicated-param "
+                    "paths (plain DP/SP, any --grad_compression): under "
+                    "ZeRO-1/FSDP/TP/EP/PP the reduced gradient exists "
+                    "only as shards, and the global norms would need the "
+                    "extra collectives the TD107 zero-cost contract "
+                    "forbids (docs/observability.md)"
+                )
+            if cfg.fused_epoch:
+                raise ValueError(
+                    "--device_metrics needs the per-step metrics fetch; "
+                    "--fused_epoch compiles the epoch into one call with "
+                    "epoch-mean metrics, so the per-step norms would be "
+                    "averaged away (refusing to silently ignore the flag)"
+                )
+        if cfg.anomaly_action not in ("off", "warn", "snapshot"):
+            raise ValueError(
+                f"anomaly_action must be off|warn|snapshot, got "
+                f"{cfg.anomaly_action!r}"
+            )
+        if cfg.anomaly_action == "snapshot" and not cfg.ckpt_dir:
+            raise ValueError(
+                "--anomaly_action snapshot writes an emergency mid-epoch "
+                "checkpoint and needs --ckpt_dir (refusing to silently "
+                "degrade to 'warn')"
+            )
+        self._anomaly = None
+        if cfg.anomaly_action != "off":
+            from tpu_dist.obs.anomaly import AnomalyDetector  # noqa: PLC0415
+
+            # raises on a degenerate window before training starts
+            self._anomaly = AnomalyDetector(
+                window=cfg.anomaly_window,
+                loss_spike=cfg.anomaly_loss_spike,
+                grad_spike=cfg.anomaly_grad_spike,
+            )
         # place on the mesh (DDP's init-time param broadcast; sharded
         # placements for TP params / ZeRO-1 optimizer state)
         self.state = self._place_state(state)
@@ -755,6 +802,14 @@ class Trainer:
         self._heartbeat = None  # created by fit() (rank 0, --heartbeat_file)
         self._trace_events = []  # drained spans held for --trace_file export
         self._step_traced = False  # first dispatch of THIS Trainer compiles
+        self._history = None  # live MetricsHistory while fit() runs — the
+        #                       step loop's device_stats/anomaly records
+        self._tb = None  # SummaryWriter while fit() runs (--tensorboard_dir)
+        # XLA cost/memory accounting of the train step, captured ONCE at
+        # first dispatch (obs/costmodel.py): {} = capture failed, don't retry
+        self._step_cost = None
+        # executable-cache watcher: counts compiles, flags mid-run retraces
+        self._compile_watch = costmodel_lib.CompileWatcher(self.train_step)
         # run identity: config hash + construction second, stamped ONCE per
         # Trainer (docs/observability.md) — every history record of this
         # run carries the same id, repeated fit() calls included, and a
@@ -847,6 +902,7 @@ class Trainer:
             param_specs=self._param_specs,
             remat=cfg.remat,
             grad_compression=cfg.grad_compression,
+            device_metrics=cfg.device_metrics,
             model_kwargs=mk or None,
         )
 
@@ -1111,7 +1167,24 @@ class Trainer:
                 "train/dispatch" if self._step_traced else "train/compile+dispatch",
                 t_d, d_d, step=step,
             )
+            if not self._step_traced:
+                # first dispatch: the executable exists now — capture XLA's
+                # cost accounting once (host-side abstract re-trace, no
+                # device work) into the flops/bytes gauges + the per-epoch
+                # MFU below. new_state, not state: state's buffers were
+                # just donated to the step.
+                self._capture_step_cost(new_state, images, labels, lr)
             self._step_traced = True
+            if self._compile_watch.observe():
+                # the executable cache grew after the first trace: a mid-run
+                # retrace (shape/dtype drift) — a full XLA compile stall on
+                # every host; compile.retraces counted by the watcher and
+                # surfaced per-epoch by `obs summarize`
+                rank0_print(
+                    f"WARNING: train step RECOMPILED at epoch {epoch} step "
+                    f"{step} — input shape/dtype drift? (compile.retraces="
+                    f"{counters_lib.get('compile.retraces'):g})"
+                )
             self._progress = (new_state, epoch, step + 1, False)
             self.state = new_state
             images_seen += cfg.batch_size
@@ -1133,6 +1206,11 @@ class Trainer:
             m = _fetch_metrics(metrics) if (want_save or want_log) else None
             if m is not None:
                 phase["fetch"] += time.perf_counter() - t_f
+                # health layer rides the SAME host copy: device_stats
+                # record, anomaly detection (incl. the nonfinite finding,
+                # logged BEFORE the NaN guard below raises), per-step
+                # TensorBoard scalars — no additional device traffic
+                self._observe_health(epoch, step, nb, m)
             if want_save:
                 # periodic EXACT snapshot (kill-9 safety for long epochs):
                 # same stamp as the interrupt path — ckpt_{epoch} carries
@@ -1162,11 +1240,17 @@ class Trainer:
                         f"(lr={lr}); restore from ckpt_dir to recover"
                     )
                 losses.update(m["loss"], cfg.batch_size)
-                # reference per-step line (distributed.py:104-111)
+                # reference per-step line (distributed.py:104-111), plus
+                # the health norms when --device_metrics computed them
                 rank0_print(
                     f"Epoch:[{epoch}/{cfg.epochs}] step:[{step}/{nb}] "
                     f"lr={lr:.5f} loss={m['loss']:.4f} "
                     f"acc1={m['acc1']:.2f} acc5={m['acc5']:.2f}"
+                    + (
+                        f" gnorm={m['grad_norm']:.3e} "
+                        f"upd={m['update_ratio']:.2e}"
+                        if "grad_norm" in m else ""
+                    )
                 )
             if preemption.requested():
                 # cooperative SIGTERM: the in-flight step is finished and
@@ -1220,6 +1304,19 @@ class Trainer:
                 f"{pct['p95'] * 1e3:.1f}/{pct['p99'] * 1e3:.1f} ms, "
                 f"data stall {stall:.1%}"
             )
+        # MFU from the captured XLA flop count over the steady-state step
+        # time (p50 excludes the compile step; fallback: epoch mean). None
+        # on unknown chips (CPU emulation) — never a made-up figure.
+        if self._step_cost and steps_run:
+            mfu = costmodel_lib.mfu(
+                self._step_cost.get("flops_per_step"),
+                pct["p50"] if pct else dt / steps_run,
+                self.n_devices,
+            )
+            if mfu is not None:
+                out["mfu"] = mfu
+                rank0_print(f"  MFU {mfu:.1%}")
+        self._publish_memory_gauges()
         counters_lib.inc("train.epochs")
         counters_lib.inc("train.steps", steps_run)
         return out
@@ -1260,6 +1357,36 @@ class Trainer:
         rank0_print(f"Epoch {epoch} done in {dt:.2f}s ({ips:.0f} img/s)")
         # device-resident data: there IS no input pipeline to stall on
         m.update(epoch_time=dt, images_per_sec=ips, data_stall_frac=0.0)
+        # cost/MFU: XLA counts the epoch program's step-scan body ONCE, so
+        # the raw count already IS per-step flops (loop_trips=1 — the
+        # epoch-level shuffle/pad epilogue is the only omission); the wall
+        # side normalizes to one step by the trip count
+        from tpu_dist.train.epoch import fused_steps_per_epoch  # noqa: PLC0415
+
+        trips = fused_steps_per_epoch(n_images, cfg.batch_size)
+        self._capture_step_cost(
+            self.state, *self._fused_data, lr, epoch,
+            runner=self._fused_runner, loop_trips=1,
+        )
+        if self._step_cost and self._step_traced:
+            # MFU only from compile-free epochs: the first fused call's dt
+            # includes the whole-epoch XLA compile (often several epochs'
+            # worth of wall time), and a 5-10x-understated epoch-0 MFU
+            # would pollute mfu_mean and the compare gate — the same
+            # discipline as the per-step path's warmup-excluded p50
+            mfu = costmodel_lib.mfu(
+                self._step_cost.get("flops_per_step"), dt / trips,
+                self.n_devices,
+            )
+            if mfu is not None:
+                m["mfu"] = mfu
+                rank0_print(f"  MFU {mfu:.1%}")
+        self._step_traced = True
+        self._publish_memory_gauges()
+        # anomaly detection at the only grain the fused path has (the
+        # epoch-mean loss); no per-step norms here — --device_metrics is
+        # refused with --fused_epoch at construction
+        self._observe_health(epoch, None, 0, m)
         if preemption.requested():
             # the fused epoch has no step grain — the epoch boundary is the
             # first cooperative point a SIGTERM can be honored at. The epoch
@@ -1276,6 +1403,130 @@ class Trainer:
     def _lr(self, epoch: int) -> float:
         """Scheduled LR times the auto-recovery backoff scale."""
         return self.lr_schedule(epoch) * self._lr_scale
+
+    def _capture_step_cost(self, *args, runner=None, loop_trips=None) -> None:
+        """ONE XLA cost-analysis capture per Trainer (obs/costmodel.py):
+        an abstract host-side re-trace of the already-compiled step —
+        no device dispatch, no second compile — published as the
+        ``device.flops_per_step``/``device.bytes_per_step`` gauges and
+        held for the per-epoch MFU. ``{}`` marks a failed capture so it
+        is never retried in the hot loop."""
+        if self._step_cost is not None:
+            return
+        cost = costmodel_lib.analyze_jitted(
+            runner if runner is not None else self.train_step,
+            *args,
+            loop_trips=(
+                loop_trips if loop_trips is not None
+                else self.cfg.grad_accu_steps
+            ),
+        )
+        self._step_cost = cost or {}
+        costmodel_lib.publish(cost)
+
+    def _publish_memory_gauges(self) -> None:
+        """Epoch-grain peak-HBM gauges from the runtime allocator's own
+        counters (the true device numbers on TPU/GPU; None on CPU, where
+        the backend keeps no stats — nothing is published)."""
+        mem = costmodel_lib.device_memory_stats()
+        if mem:
+            for key, value in mem.items():
+                counters_lib.set_gauge(f"mem.{key}", value)
+
+    def _observe_health(self, epoch: int, step, nb: int, m: dict) -> None:
+        """Per-fetch health layer over the metrics the loop already holds
+        on the host — zero additional device traffic (TD107's fetch-count
+        half). Writes the ``device_stats`` history record, per-step
+        TensorBoard scalars, and feeds the anomaly detector; findings
+        become rank-0 warnings + ``anomaly`` records, and
+        ``--anomaly_action snapshot`` writes an exact mid-epoch
+        checkpoint (emergency-snapshot discipline) while the state is
+        still finite. The detector state is deterministic and the fed
+        values are replica-identical (post-pmean), so every process takes
+        the same snapshot branch — the collective save stays aligned."""
+        cfg = self.cfg
+        history = self._history
+        if history is not None and "grad_norm" in m:
+            history.log(
+                "device_stats", epoch=epoch, step=step,
+                **{
+                    k: m[k]
+                    for k in (
+                        "grad_norm", "param_norm", "update_ratio",
+                        "nonfinite_grads",
+                    )
+                    if k in m
+                },
+            )
+        if self._tb is not None and step is not None:
+            gs = epoch * nb + step
+            self._tb.add_scalar("step/loss", m["loss"], gs)
+            for k in ("grad_norm", "update_ratio"):
+                if k in m:
+                    self._tb.add_scalar(f"step/{k}", m[k], gs)
+        if self._anomaly is None:
+            return
+        findings = self._anomaly.observe(
+            epoch=epoch, step=step, loss=m.get("loss"),
+            grad_norm=m.get("grad_norm"), nonfinite=m.get("nonfinite_grads"),
+        )
+        for f in findings:
+            rank0_print(
+                f"WARNING: anomaly {f['anomaly']} at epoch {epoch} step "
+                f"{step}: value {f.get('value')}"
+                + (
+                    f" = {f['ratio']}x the rolling median {f['median']}"
+                    if f.get("ratio") is not None else ""
+                )
+            )
+            if history is not None:
+                history.log("anomaly", **f)
+            counters_lib.inc("anomaly.findings")
+            if (
+                cfg.anomaly_action == "snapshot"
+                and cfg.ckpt_dir
+                and f["anomaly"] in ("loss_spike", "grad_norm_explosion")
+            ):
+                # pre-divergence forensic snapshot: the spike kinds fire
+                # on FINITE values only, so the state is still safe to
+                # publish. Written OFF the ckpt_{N} namespace (no "ckpt_"
+                # substring — the discovery regexes cannot match it), so
+                # the next periodic/end-of-epoch save can never overwrite
+                # it, prune never removes it, and resume never silently
+                # picks it — the pre-divergence bits stay on disk for as
+                # long as the operator wants them. Stamped with the
+                # finding + the exact position (mid_epoch_* for the
+                # streaming path; the fused path's only grain is the
+                # epoch boundary), so a manual rollback knows where it
+                # re-enters. Synchronous plain write even under
+                # --async_ckpt: a rare forensic event, not hot-path I/O.
+                extra = {**self._ckpt_meta(), "anomaly": f["anomaly"]}
+                if step is not None:
+                    extra.update(
+                        mid_epoch_step=step + 1,
+                        mid_epoch_batch_size=cfg.batch_size,
+                        mid_epoch_seed=cfg.seed or 0,
+                    )
+                stem = f"anomaly_{epoch}" + (
+                    f"_s{step + 1}" if step is not None else ""
+                )
+                if cfg.sharded_ckpt:
+                    ckpt_lib.save_sharded(
+                        cfg.ckpt_dir, self.state, epoch,
+                        extra_meta=extra, stem=stem,
+                    )
+                else:
+                    ckpt_lib.save(
+                        cfg.ckpt_dir, self.state, epoch,
+                        extra_meta=extra, name=f"{stem}.npz",
+                    )
+                counters_lib.inc("anomaly.snapshots")
+                rank0_print(
+                    f"=> anomaly snapshot written ({stem}, epoch {epoch}"
+                    + (f" step {step + 1}" if step is not None else "")
+                    + ") — pre-divergence state preserved off the resume "
+                    "namespace"
+                )
 
     def _apply_step_faults(self, epoch: int, step: int, lr: float) -> None:
         """Host-side --fault_plan actions at the step grain. A matching
@@ -1496,6 +1747,10 @@ class Trainer:
         history = MetricsHistory(
             cfg.log_file, run_id=run_id, t0=self._telemetry_t0
         )
+        # the step loop's health records (device_stats / anomaly) write
+        # through this handle; cleared in the finally below so a direct
+        # train_epoch() call outside fit() never logs to a closed file
+        self._history = history
         # re-arm host-span tracing (construction armed it before the
         # resume-path restore; a second fit() on this Trainer re-arms after
         # _export_telemetry disarmed) WITHOUT clearing or re-zeroing — the
@@ -1593,6 +1848,7 @@ class Trainer:
                 self._tb.close()
             if telemetry:
                 self._export_telemetry(history)
+            self._history = None
             history.close()
             self._heartbeat = None
 
@@ -1811,7 +2067,7 @@ class Trainer:
                 if srec["straggler"]:
                     history.log("straggler", epoch=epoch, **srec)
             if self._tb is not None:
-                for k in ("loss", "acc1", "acc5", "images_per_sec"):
+                for k in ("loss", "acc1", "acc5", "images_per_sec", "mfu"):
                     if k in last:
                         self._tb.add_scalar(f"train/{k}", last[k], epoch)
                 self._tb.add_scalar("train/lr", self._lr(epoch), epoch)
