@@ -1,6 +1,6 @@
 # Convenience targets; CI / the driver call the underlying commands directly.
 
-.PHONY: test quick bench csrc clean lint
+.PHONY: test quick bench csrc clean lint pod-report
 
 csrc:
 	$(MAKE) -C tpu_dist/csrc
@@ -19,6 +19,13 @@ quick:
 
 bench:
 	python bench.py
+
+# Cross-host pod report over per-host --log_file histories:
+#   make pod-report LOGS="run.jsonl run.jsonl.h1" [TRACE=pod_trace.json]
+# (docs/observability.md — per-host goodput ledgers, skew attribution,
+# and optionally one merged Perfetto timeline)
+pod-report:
+	python -m tpu_dist.obs pod $(LOGS) $(if $(TRACE),--trace-out $(TRACE))
 
 clean:
 	$(MAKE) -C tpu_dist/csrc clean

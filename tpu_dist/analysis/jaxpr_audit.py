@@ -713,13 +713,69 @@ def device_metrics_noop_violations(mesh=None) -> list[Violation]:
     return out
 
 
+def profile_trigger_noop_violations(mesh=None) -> list[Violation]:
+    """TD108: the triggered-profiler cost contract, checked at the
+    program level (the TD105-TD107 armed-vs-off discipline applied to
+    ``obs/profile.py``) — trace the data-parallel step with no profiler,
+    then again with a :class:`TriggeredProfiler` ARMED (a health trigger
+    has fired, the capture is pending), and again with the capture window
+    OPEN (a real ``jax.profiler`` trace in flight), and require all three
+    jaxprs to be byte-identical. Arming is host bookkeeping and an open
+    window only observes the program XLA already built; the moment
+    someone routes a "helpful" marker op or a step-numbering annotation
+    through the traced step, this trips."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.obs.profile import TriggeredProfiler
+
+    m = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+    fn, args = _dp_setup(m)
+    base = str(jax.make_jaxpr(fn)(*args))
+    tmp = tempfile.mkdtemp(prefix="td108_profile_")
+    out: list[Violation] = []
+    try:
+        prof = TriggeredProfiler(
+            tmp, window_steps=2, cooldown_steps=0, max_captures=1
+        )
+        prof.arm("anomaly_loss_spike")
+        fn2, args2 = _dp_setup(m)
+        armed = str(jax.make_jaxpr(fn2)(*args2))
+        started = prof.on_step(0)  # opens a REAL device-trace window
+        capturing = str(jax.make_jaxpr(fn2)(*args2))
+        prof.close()
+        # a capture-backend failure (no profiler available here) leaves
+        # nothing in flight; the armed comparison above still gates
+        capture_ran = bool(started and started.get("event") == "start")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if base != armed or (capture_ran and base != capturing):
+        out.append(
+            Violation(
+                "TD108",
+                "<jaxpr:dp_profile_trigger_noop>",
+                0,
+                "the traced train step CHANGED when a profiler trigger "
+                "was armed (or a capture window was open) — triggered "
+                "profiling must stay control-plane only: host bookkeeping "
+                "plus jax.profiler start/stop around the unmodified step "
+                "(obs/profile.py contract)",
+                snippet="jaxpr(profiler_off) != jaxpr(trigger_armed)",
+            )
+        )
+    return out
+
+
 def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     """Run every (or the named) registered case. Returns
     ``(report, violations)`` where report maps case → op counts.
     Cross-case TD104 wire-ratio checks run over whichever quantized/
     reference pairs the report contains; full (unfiltered) runs also check
-    the TD105 fault-injection, TD106 telemetry, and TD107 device-metrics
-    no-op invariants."""
+    the TD105 fault-injection, TD106 telemetry, TD107 device-metrics, and
+    TD108 profiler-trigger no-op invariants."""
     report: dict = {}
     violations: list[Violation] = []
     for name in names if names is not None else registered_cases():
@@ -736,6 +792,9 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
         violations.extend(vs)
         vs = device_metrics_noop_violations(mesh)
         report["dp_device_metrics_noop"] = {"identical": not vs}
+        violations.extend(vs)
+        vs = profile_trigger_noop_violations(mesh)
+        report["dp_profile_trigger_noop"] = {"identical": not vs}
         violations.extend(vs)
     return report, violations
 

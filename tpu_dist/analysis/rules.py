@@ -131,6 +131,14 @@ RULES: dict[str, Rule] = {
             "existing single per-step fetch — obs/device_stats.py)",
         ),
         Rule(
+            "TD108",
+            "profile-trigger-not-noop",
+            "the traced train step differs between no profiler and an "
+            "armed/capturing triggered profiler — capture control must "
+            "stay host-side (arm flags, jax.profiler start/stop around "
+            "the unmodified step; obs/profile.py contract)",
+        ),
+        Rule(
             "TD104",
             "quantized-wire-bytes-over-budget",
             "gradient-collective payload bytes of a quantized wire format "

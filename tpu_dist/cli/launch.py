@@ -27,6 +27,25 @@ launcher then exits with that same distinct code (75, EX_TEMPFAIL) so the
 orchestrator can requeue instead of treating preemption as a crash. A
 child that exits with the preemption code on its own (e.g. a per-host
 SIGTERM) propagates it the same way.
+
+Watchdog contract (docs/observability.md): ``--heartbeat_dir`` injects
+ONE base ``--heartbeat_file`` into every child; each process derives its
+per-rank file from it (rank 0 keeps the bare path, rank k appends
+``.h<k>``) and the launcher reads the same scheme back
+(``heartbeat.read``). With ``--watchdog_timeout`` set, a child
+whose beat counter stops advancing for that long while the process is
+still alive is WEDGED — a deadlocked collective or dead loader, which no
+exit code will ever report — and the launcher says which host stalled, in
+which phase and at which position, counts the stall as goodput loss, and
+terminates it (SIGTERM, then SIGKILL after ``--watchdog_grace``) instead
+of waiting forever. A watchdog kill is a failure, not a preemption: the
+launcher exits nonzero even if the dying child manages its graceful
+exit-75, because requeueing a deterministic wedge would loop the
+orchestrator on it forever. Size the timeout above the worst cold-compile
+stall — the watchdog cannot tell a wedged step from one that never beat.
+Once a preemption shutdown begins the watchdog stands down: children beat
+once ('preempted') then go silent in the emergency save by design, and
+reclassifying that as a wedge would turn the requeue-75 exit into a crash.
 """
 
 from __future__ import annotations
@@ -37,7 +56,8 @@ import signal
 import socket
 import subprocess
 import sys
-from typing import List, Optional, Sequence
+import time
+from typing import Dict, List, Optional, Sequence
 
 from tpu_dist.resilience.preemption import PREEMPTION_EXIT_CODE
 
@@ -57,6 +77,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "--devices_per_proc", type=int, default=0,
         help=">0: give each process N emulated CPU devices (testing mode)",
     )
+    p.add_argument(
+        "--heartbeat_dir", default=None,
+        help="inject --heartbeat_file <dir>/hb.json into every child "
+             "(each process beats its own derived file: rank 0 the bare "
+             "path, rank k .h<k>) and watch the files for liveness",
+    )
+    p.add_argument(
+        "--watchdog_timeout", type=float, default=0.0, metavar="S",
+        help="with --heartbeat_dir: a child whose heartbeat counter "
+             "stops advancing for S seconds while the process lives is "
+             "wedged — report which host/phase and terminate it instead "
+             "of waiting forever; 0 disables. Must exceed the worst "
+             "compile stall",
+    )
+    p.add_argument(
+        "--watchdog_grace", type=float, default=10.0, metavar="S",
+        help="seconds between the watchdog's SIGTERM and its SIGKILL",
+    )
     p.add_argument("cmd", nargs=argparse.REMAINDER, help="-- command to run")
     args = p.parse_args(argv)
 
@@ -65,9 +103,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         cmd = cmd[1:]
     if not cmd:
         p.error("missing command (after --)")
+    if args.watchdog_timeout > 0 and not args.heartbeat_dir:
+        p.error("--watchdog_timeout needs --heartbeat_dir (the liveness "
+                "signal it watches)")
     port = args.port or _free_port()
 
+    hb_base = None
+    if args.heartbeat_dir:
+        os.makedirs(args.heartbeat_dir, exist_ok=True)
+        # one BASE path injected into every child; the trainer derives its
+        # per-rank file from it (heartbeat.per_rank_path — rank 0 = bare
+        # path, rank k = .h<k>), and the watchdog reads the same scheme
+        hb_base = os.path.join(args.heartbeat_dir, "hb.json")
+
     procs: List[subprocess.Popen] = []
+    ranks: Dict[subprocess.Popen, int] = {}
     preempted = [False]
 
     def _forward_sigterm(signum, frame):  # noqa: ARG001
@@ -101,16 +151,80 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "--ip", args.ip,
                 "--port", str(port),
             ]
-            procs.append(subprocess.Popen(child, env=env))
+            if hb_base is not None:
+                child += ["--heartbeat_file", hb_base]
+            pr = subprocess.Popen(child, env=env)
+            procs.append(pr)
+            ranks[pr] = rank
 
         rc = 0
         crash_rc = 0  # first exit that is neither clean, preemption, nor
         # death-by-our-own-SIGTERM — a REAL failure that must never be
         # reported as "requeue me"
+        # watchdog state per rank: last seen beat counter, when it last
+        # advanced (spawn counts as the first advance — a child that never
+        # beats at all is as wedged as one that stopped), and the SIGKILL
+        # deadline once the watchdog fired
+        now = time.monotonic()
+        wd_seen: Dict[int, tuple] = {ranks[pr]: (None, now) for pr in procs}
+        wd_kill_at: Dict[int, float] = {}
+        watchdog = args.watchdog_timeout > 0
+
+        def _watch(pr) -> None:
+            nonlocal crash_rc
+            from tpu_dist.obs import heartbeat as heartbeat_lib  # noqa: PLC0415
+
+            if preempted[0]:
+                # preemption shutdown: each child beats once ('preempted')
+                # then goes silent in its emergency save BY DESIGN — a
+                # frozen counter here is not a wedge, and reclassifying it
+                # would turn the requeue-75 exit into a crash. A truly
+                # stuck shutdown is bounded by the platform's own SIGKILL
+                # deadline, not by us.
+                return
+            rank = ranks[pr]
+            t = time.monotonic()
+            if rank in wd_kill_at:
+                if t >= wd_kill_at[rank]:
+                    pr.kill()  # SIGTERM grace expired — it really is stuck
+                return
+            rec = heartbeat_lib.read(heartbeat_lib.per_rank_path(hb_base, rank))
+            counter = rec.get("counter") if rec else None
+            last_counter, last_adv = wd_seen[rank]
+            if counter != last_counter:
+                wd_seen[rank] = (counter, t)
+                return
+            stalled = t - last_adv
+            if stalled < args.watchdog_timeout:
+                return
+            # wedged: alive but silent — no exit code would ever tell us
+            where = (
+                f"epoch {rec.get('epoch')} step {rec.get('step')} phase "
+                f"{rec.get('phase')!r}" if rec else "before its first beat"
+            )
+            # tpu-dist: ignore[TD002,TD007] — the launcher IS the single
+            # parent process (no ranks to guard), and stderr is its
+            # contract with the orchestrator, same as the exit codes
+            print(
+                f"launch: WATCHDOG: worker {rank} wedged — heartbeat "
+                f"stalled {stalled:.0f}s at {where}; terminating "
+                f"(~{stalled:.0f}s goodput loss on this host)",
+                file=sys.stderr, flush=True,
+            )
+            if crash_rc == 0:
+                crash_rc = 1  # a wedge is a failure, never a requeue-75
+            wd_kill_at[rank] = t + args.watchdog_grace
+            try:
+                pr.send_signal(signal.SIGTERM)
+            except OSError:  # tpu-dist: ignore[TD006] — child already gone
+                pass
+
         while procs:
             for pr in list(procs):
                 ret = pr.poll()
                 if ret is None:
+                    if watchdog:
+                        _watch(pr)
                     continue
                 procs.remove(pr)
                 if ret == PREEMPTION_EXIT_CODE:
@@ -129,7 +243,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 except subprocess.TimeoutExpired:
                     pass
         if crash_rc:
-            return crash_rc  # a crash outranks a concurrent preemption
+            return crash_rc  # a crash/wedge outranks a concurrent preemption
         if preempted[0] and rc in (0, PREEMPTION_EXIT_CODE, -signal.SIGTERM):
             # the whole job was preempted (not crashed): surface the
             # distinct requeue-me code even if some child died on the raw

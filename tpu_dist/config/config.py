@@ -99,8 +99,9 @@ class TrainConfig:
                                    # Perfetto-loadable; rank 0. Spans are
                                    # also armed when log_file is set (they
                                    # ride the JSONL as 'spans' records)
-    heartbeat_file: Optional[str] = None  # rank-0 liveness file updated at
-                                   # the step grain (monotonic counter +
+    heartbeat_file: Optional[str] = None  # per-process liveness file (rank
+                                   # 0 the bare path, rank k .h<k>) updated
+                                   # at the step grain (monotonic counter +
                                    # epoch/step); swept on clean exit —
                                    # external watchdogs distinguish a hung
                                    # step from a slow one
@@ -127,6 +128,21 @@ class TrainConfig:
     anomaly_loss_spike: float = 3.0   # loss > X * rolling median => anomaly
     anomaly_grad_spike: float = 10.0  # grad_norm > X * rolling median
                                    # (needs --device_metrics for the norm)
+    per_host_log: bool = False     # every process writes its own JSONL
+                                   # history (<log_file>.h<rank>; rank 0
+                                   # keeps the bare path) so `obs pod`
+                                   # can merge a cross-host view
+    profile_trigger: str = "off"   # off | auto | comma list of
+                                   # anomaly,straggler,retrace — arm a
+                                   # bounded jax.profiler capture when
+                                   # the health signal fires
+                                   # (obs/profile.py; needs profile_dir)
+    profile_steps: Optional[str] = None  # "a:b": manual capture of global
+                                   # steps [a, b) (needs profile_dir;
+                                   # replaces the epoch-0 blanket trace)
+    profile_window: int = 8        # steps per triggered capture
+    profile_cooldown: int = 200    # min steps between triggered captures
+    profile_max_captures: int = 3  # triggered-capture cap per process
 
     # -- TPU fast path -------------------------------------------------------
     fused_epoch: bool = False      # device-resident data, one jit per epoch
@@ -343,8 +359,9 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "the end of the run (Perfetto / chrome://tracing "
                         "loadable; rank 0 — docs/observability.md)")
     p.add_argument("--heartbeat_file", type=str, default=None,
-                   help="rank-0 liveness file rewritten at the step grain "
-                        "(monotonic beat counter + epoch/step position), "
+                   help="per-process liveness file rewritten at the step "
+                        "grain (rank 0 the bare path, rank k .h<k>; "
+                        "monotonic beat counter + epoch/step position), "
                         "swept on clean exit — lets an external watchdog "
                         "tell a hung step from a slow one")
     p.add_argument("--straggler_threshold", type=float,
@@ -379,6 +396,37 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    default=d.anomaly_grad_spike, metavar="X",
                    help="flag a grad norm above X times the rolling median "
                         "(grad norms need --device_metrics)")
+    p.add_argument("--profile_dir", type=str, default=None,
+                   help="XLA profile output dir: alone, captures epoch 0 "
+                        "(TensorBoard profile tab); with --profile_trigger/"
+                        "--profile_steps, holds their bounded capture "
+                        "windows instead")
+    p.add_argument("--per_host_log", action="store_true",
+                   help="every process writes its own JSONL history "
+                        "(<log_file>.h<rank>; rank 0 keeps the bare path) "
+                        "so `python -m tpu_dist.obs pod` can merge the "
+                        "cross-host view (docs/observability.md)")
+    p.add_argument("--profile_trigger", type=str, default=d.profile_trigger,
+                   help="arm a bounded on-device profiler capture when a "
+                        "health signal fires: 'auto' (all), or a comma "
+                        "list of anomaly,straggler,retrace; 'off' (the "
+                        "default) disables. Anomaly/retrace captures run "
+                        "on rank 0; straggler captures on the flagged "
+                        "host. Needs --profile_dir; bounded by "
+                        "--profile_window/cooldown/max_captures")
+    p.add_argument("--profile_steps", type=str, default=None, metavar="A:B",
+                   help="manually capture global steps [A, B) to "
+                        "--profile_dir (replaces the epoch-0 blanket "
+                        "trace that --profile_dir alone takes)")
+    p.add_argument("--profile_window", type=int, default=d.profile_window,
+                   help="steps per triggered profiler capture")
+    p.add_argument("--profile_cooldown", type=int,
+                   default=d.profile_cooldown,
+                   help="minimum steps between triggered captures")
+    p.add_argument("--profile_max_captures", type=int,
+                   default=d.profile_max_captures,
+                   help="cap on triggered captures per process (an anomaly "
+                        "storm must not trace the whole run)")
     p.add_argument("--eval_every", type=int, default=d.eval_every,
                    help="epochs between evaluations; 0 disables")
     p.add_argument("--save_every", type=int, default=d.save_every)

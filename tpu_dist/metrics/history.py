@@ -19,9 +19,14 @@ Schema (version 3): every record carries
 
 Version history: v2 added ``rel_s``/``run_id``/``counters``; v3 added the
 device-health layer — ``device_stats`` and ``anomaly`` record kinds and
-the ``mfu`` field on ``train_epoch`` (docs/observability.md). Consumers
-(``obs summarize``/``compare``) read all versions: every addition is a
-new kind or optional field, never a changed one.
+the ``mfu`` field on ``train_epoch``; v4 added the fleet layer —
+``goodput`` (per-window wall-clock buckets + a run-end ``final`` totals
+record) and ``profile`` (triggered device-capture events) kinds
+(docs/observability.md). Consumers (``obs summarize``/``compare``) read
+all versions: every addition is a new kind or optional field, never a
+changed one, and readers skip-with-count kinds they don't know — so a
+v3 reader tolerates a v4 log the same way a v4 reader tolerates a v5
+one.
 
 The file handle is opened once, line-buffered, and reused — the previous
 open-per-``log()`` implementation paid a file open/close every record and
@@ -39,7 +44,7 @@ import jax
 
 from tpu_dist.obs import counters as counters_lib
 
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 
 class MetricsHistory:
@@ -48,21 +53,29 @@ class MetricsHistory:
         path: Optional[str],
         run_id: Optional[str] = None,
         t0: Optional[float] = None,
+        all_processes: bool = False,
     ):
-        """``path=None`` disables (and any non-primary process is a no-op).
+        """``path=None`` disables (and any non-primary process is a no-op
+        unless ``all_processes`` — the Trainer's ``--per_host_log``, where
+        every process writes its own rank-suffixed file for ``obs pod``
+        aggregation; the caller owns making the paths distinct).
         ``run_id`` identifies the run in every record; the Trainer passes
         its config-hash + start-time stamp. ``t0`` (a ``time.monotonic()``
         reading) overrides the ``rel_s`` origin — the Trainer passes its
         construction instant, the SAME origin its span recorder zeroes at,
         so exported epoch bars and host spans share one timeline."""
-        self.path = path if (path and jax.process_index() == 0) else None
+        self.path = path if (
+            path and (all_processes or jax.process_index() == 0)
+        ) else None
         self.run_id = run_id
         self._f = None
         self._t0 = t0 if t0 is not None else time.monotonic()
         if self.path:
             os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
             # tpu-dist: ignore[TD002] — self.path is None off rank 0 (guard
-            # in __init__), so this handle only ever exists on the primary.
+            # in __init__) unless the caller opted into per-process files
+            # (all_processes, distinct rank-suffixed paths), so this handle
+            # never contends cross-process.
             # buffering=1: line-buffered — each record is flushed whole, so
             # tail -f / a concurrent summarize sees complete lines only.
             self._f = open(self.path, "a", buffering=1)

@@ -1,24 +1,27 @@
-"""Run telemetry + device-side training health: span tracing, subsystem
-counters, heartbeat, straggler detection, in-step health scalars
-(``device_stats``), cost/MFU accounting (``costmodel``), anomaly
-detection, and the offline ``python -m tpu_dist.obs summarize`` /
-``compare`` CLI.
+"""Run telemetry + device-side training health + the fleet layer: span
+tracing, subsystem counters, heartbeat, straggler detection, in-step
+health scalars (``device_stats``), cost/MFU accounting (``costmodel``),
+anomaly detection, the goodput ledger (``goodput``), triggered device
+profiling (``profile``), pod aggregation (``aggregate``), and the
+offline ``python -m tpu_dist.obs summarize`` / ``compare`` / ``pod``
+CLI.
 
-Contract (audited by TD106/TD107): the host-telemetry half is host-side
-only — arming it leaves the traced train step byte-identical and adds no
+Contract (audited by TD106/TD107/TD108): the host-telemetry half —
+goodput ledger and profiler trigger control included — is host-side
+only: arming it leaves the traced train step byte-identical and adds no
 per-step device transfers. The one deliberately device-side piece,
 ``device_stats`` (opt-in ``--device_metrics``), adds zero collectives and
 rides the existing single per-step metrics fetch. See
 ``docs/observability.md``.
 """
 
-from tpu_dist.obs import counters, spans  # noqa: F401
+from tpu_dist.obs import counters, goodput, spans  # noqa: F401
 
 
 def __getattr__(name):
     # lazy: straggler/heartbeat/device_stats/costmodel pull in jax or the
     # (jax-importing) logging layer; the offline CLI and the loader
-    # producer thread only need counters/spans
+    # producer thread only need counters/spans/goodput (stdlib-only)
     if name == "Heartbeat":
         from tpu_dist.obs.heartbeat import Heartbeat
 
@@ -31,4 +34,10 @@ def __getattr__(name):
         from tpu_dist.obs.anomaly import AnomalyDetector
 
         return AnomalyDetector
+    if name == "TriggeredProfiler":
+        from tpu_dist.obs.profile import TriggeredProfiler
+
+        return TriggeredProfiler
+    if name == "GoodputLedger":
+        return goodput.GoodputLedger
     raise AttributeError(f"module 'tpu_dist.obs' has no attribute {name!r}")

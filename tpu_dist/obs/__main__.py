@@ -11,11 +11,20 @@ Subcommands::
         from the run's drained spans + synthesized epoch/eval bars.
 
     compare <baseline.jsonl> <candidate.jsonl> [--threshold 0.05]
-            [--bench] [--format text|json]
+            [--bench] [--goodput] [--format text|json]
         Regression gate: diff throughput, step-time percentiles, stall
-        fraction, MFU, and final metrics between two runs' logs (or, with
-        --bench, two bench.py JSON outputs). Exits 1 on any regression
-        beyond the threshold — wire it into CI.
+        fraction, MFU, goodput fraction, and final metrics between two
+        runs' logs (or, with --bench, two bench.py JSON outputs).
+        --goodput restricts the gate to the time-to-useful-work metrics
+        (run-level goodput_frac + stall fraction). Exits 1 on any
+        regression beyond the threshold — wire it into CI.
+
+    pod <host0.jsonl> <host1.jsonl> ... [--heartbeat hb.json ...]
+        [--trace-out pod_trace.json] [--format text|json]
+        Cross-host aggregation: per-host goodput ledgers side by side,
+        per-epoch skew with phase attribution, heartbeat liveness, and
+        (with --trace-out) one merged Perfetto timeline with a track per
+        host, aligned on the shared run clock.
 
 Exit codes: 0 ok, 1 empty/unusable input (or, for ``compare``, a
 regression), 2 bad invocation or I/O error.
@@ -59,8 +68,58 @@ def main(argv=None) -> int:
         help="inputs are bench.py JSON outputs (one object per line), "
              "matched by their 'metric' name",
     )
+    c.add_argument(
+        "--goodput", action="store_true",
+        help="gate on the time-to-useful-work metrics only (run-level "
+             "goodput fraction + data-stall fraction); two goodput-less "
+             "pre-v4 logs then compare nothing → exit 2, never a silent "
+             "pass",
+    )
     c.add_argument("--format", choices=("text", "json"), default="text")
+    pd = sub.add_parser(
+        "pod",
+        help="merge per-host logs into one cross-host report / timeline",
+    )
+    pd.add_argument("logs", nargs="+", help="per-host JSONL histories")
+    pd.add_argument(
+        "--heartbeat", action="append", default=[], metavar="FILE",
+        help="per-host heartbeat file(s) to include as liveness rows",
+    )
+    pd.add_argument(
+        "--trace-out", default=None, metavar="PATH",
+        help="also write one merged Perfetto trace (a track per host)",
+    )
+    pd.add_argument("--format", choices=("text", "json"), default="text")
     args = ap.parse_args(argv)
+
+    if args.cmd == "pod":
+        from tpu_dist.obs import aggregate as aggregate_lib
+
+        hosts = []
+        for path in args.logs:
+            try:
+                records, _bad = summ.load_records(path)
+            except OSError as e:
+                print(f"tpu_dist.obs: cannot read {path}: {e}", file=sys.stderr)
+                return 2
+            if not records:
+                print(f"tpu_dist.obs: no records in {path}", file=sys.stderr)
+                return 1
+            hosts.append((path, records))
+        report = aggregate_lib.pod_report(hosts, heartbeats=args.heartbeat)
+        if args.format == "json":
+            print(json.dumps(report, indent=2))
+        else:
+            print(aggregate_lib.format_text(report))
+        if args.trace_out:
+            trace = aggregate_lib.pod_trace(hosts)
+            with open(args.trace_out, "w") as f:
+                json.dump(trace, f)
+            print(
+                f"wrote {len(trace['traceEvents'])} event(s) across "
+                f"{len(hosts)} host track(s) to {args.trace_out}"
+            )
+        return 0
 
     if args.cmd == "compare":
         from tpu_dist.obs import compare as compare_lib
@@ -69,6 +128,7 @@ def main(argv=None) -> int:
             result = compare_lib.compare_files(
                 args.baseline, args.candidate,
                 threshold=args.threshold, bench=args.bench,
+                goodput_only=args.goodput,
             )
         except (OSError, ValueError) as e:
             print(f"tpu_dist.obs: compare failed: {e}", file=sys.stderr)

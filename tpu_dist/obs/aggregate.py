@@ -1,0 +1,254 @@
+"""Pod-wide telemetry aggregation — ``python -m tpu_dist.obs pod``.
+
+Everything else in ``tpu_dist/obs`` reports one process at a time; pod
+debugging is a CROSS-host exercise (arXiv:1909.09756: MLPerf-scale TPU
+debugging lives or dies on cross-host timeline correlation). This module
+merges per-host artifacts into one view:
+
+* **Report** (:func:`pod_report`): each host's ``--log_file`` JSONL is
+  folded through ``summarize``; the pod report puts the per-host goodput
+  ledgers side by side, computes per-epoch cross-host skew from the
+  epoch times the logs already carry, and attributes each straggling
+  epoch to a phase — a host slow WITH a high data-stall fraction is an
+  input-pipeline problem, one slow WITHOUT it is compute/other (exactly
+  the triage rule the in-run straggler warning prints). Heartbeat files
+  (``--heartbeat``) add a liveness row per host: position, phase, and
+  how stale the last beat is.
+* **Trace** (:func:`pod_trace`): per-host Chrome traces (spans + epoch
+  bars, via ``summarize.export_trace``) merged into ONE Perfetto
+  timeline — one ``pid`` track per host, named by a metadata event, and
+  aligned on the shared clock: each host's wall origin is recovered as
+  ``ts - rel_s`` of its first record, and every host is shifted by its
+  offset from the earliest origin, so skew between hosts renders as real
+  horizontal displacement instead of every track pretending to start at
+  zero.
+
+Per-host logs come from ``--per_host_log`` (each process writes
+``<log_file>.h<rank>``; rank 0 keeps the bare path) or from any N
+separately-collected ``--log_file`` JSONLs of the same logical run.
+Pure host-side file crunching — no jax, runs anywhere the logs can be
+copied to.
+"""
+
+from __future__ import annotations
+
+import time
+from statistics import median
+from typing import List, Optional, Tuple
+
+from tpu_dist.obs import goodput as goodput_lib
+from tpu_dist.obs import summarize as summ
+
+#: A straggling epoch is attributed to the input pipeline when the slow
+#: host's data-stall fraction exceeds the other hosts' median by this
+#: many absolute points.
+_STALL_ATTRIBUTION_MARGIN = 0.1
+
+
+def _wall_origin(records: List[dict]) -> Optional[float]:
+    """The host's clock-zero (Trainer construction) on the wall clock:
+    ``ts - rel_s`` of the first record carrying both."""
+    for rec in records:
+        ts, rel = rec.get("ts"), rec.get("rel_s")
+        if isinstance(ts, (int, float)) and isinstance(rel, (int, float)):
+            return float(ts) - float(rel)
+    return None
+
+
+def epoch_skew_rows(hosts: List[Tuple[str, dict]]) -> List[dict]:
+    """Cross-host skew per epoch, with phase attribution. ``hosts`` is
+    ``[(name, summarize_report), ...]``."""
+    by_epoch: dict = {}
+    for name, rep in hosts:
+        for row in rep.get("epochs", []):
+            e = row.get("epoch")
+            t = row.get("epoch_time_s")
+            if e is None or not isinstance(t, (int, float)):
+                continue
+            by_epoch.setdefault(e, []).append((name, row))
+    out: List[dict] = []
+    for e in sorted(by_epoch):
+        entries = by_epoch[e]
+        if len(entries) < 2:
+            continue
+        times = [row["epoch_time_s"] for _, row in entries]
+        med = median(times)
+        worst_i = max(range(len(entries)), key=lambda i: times[i])
+        worst_name, worst_row = entries[worst_i]
+        skew = times[worst_i] / med if med > 0 else 1.0
+        stalls = [
+            row.get("data_stall_frac")
+            for i, (_, row) in enumerate(entries)
+            if i != worst_i and isinstance(row.get("data_stall_frac"), (int, float))
+        ]
+        worst_stall = worst_row.get("data_stall_frac")
+        phase = "unknown"
+        if isinstance(worst_stall, (int, float)) and stalls:
+            phase = (
+                "data_stall"
+                if worst_stall - median(stalls) > _STALL_ATTRIBUTION_MARGIN
+                else "compute/other"
+            )
+        out.append({
+            "epoch": e,
+            "hosts": len(entries),
+            "median_s": round(med, 4),
+            "max_s": round(times[worst_i], 4),
+            "skew": round(skew, 4),
+            "worst_host": worst_name,
+            "worst_stall_frac": worst_stall,
+            "attribution": phase,
+        })
+    return out
+
+
+def heartbeat_rows(
+    paths: List[str], now: Optional[float] = None
+) -> List[dict]:
+    """Liveness row per heartbeat file (``obs/heartbeat.py`` format):
+    position + beat age. An absent file reads as a clean exit — that is
+    the heartbeat contract, not an error."""
+    from tpu_dist.obs import heartbeat as heartbeat_lib  # stdlib-only
+
+    now = time.time() if now is None else now
+    out = []
+    for path in paths:
+        rec = heartbeat_lib.read(path)
+        if rec is None:
+            out.append({"file": path, "status": "absent (clean exit or not started)"})
+            continue
+        age = now - rec["ts"] if isinstance(rec.get("ts"), (int, float)) else None
+        out.append({
+            "file": path,
+            "status": "present",
+            "counter": rec.get("counter"),
+            "epoch": rec.get("epoch"),
+            "step": rec.get("step"),
+            "phase": rec.get("phase"),
+            "beat_age_s": round(age, 1) if age is not None else None,
+        })
+    return out
+
+
+def pod_report(
+    host_records: List[Tuple[str, List[dict]]],
+    heartbeats: Optional[List[str]] = None,
+) -> dict:
+    """The merged cross-host report over ``[(host_name, records), ...]``."""
+    hosts = []
+    reports = []
+    for name, records in host_records:
+        rep = summ.summarize(records)
+        reports.append((name, rep))
+        gp = rep.get("goodput")
+        hosts.append({
+            "host": name,
+            "run_id": rep.get("run_id"),
+            "n_epochs": rep["totals"]["n_epochs"],
+            "images_per_sec_mean": rep["totals"].get("images_per_sec_mean"),
+            "goodput": gp,
+            "stragglers": rep.get("stragglers", []),
+            "anomalies": len(rep.get("anomalies", [])),
+            "profiles": rep.get("profiles", []),
+            "skipped_kinds": rep.get("skipped_kinds", {}),
+        })
+    fracs = [
+        h["goodput"]["goodput_frac"] for h in hosts
+        if h.get("goodput") and isinstance(
+            h["goodput"].get("goodput_frac"), (int, float)
+        )
+    ]
+    worst = min(
+        (h for h in hosts if h.get("goodput")),
+        key=lambda h: h["goodput"].get("goodput_frac", 1.0),
+        default=None,
+    )
+    return {
+        "n_hosts": len(hosts),
+        "hosts": hosts,
+        "epoch_skew": epoch_skew_rows(reports),
+        "heartbeats": heartbeat_rows(heartbeats) if heartbeats else [],
+        "pod": {
+            "goodput_frac_min": min(fracs) if fracs else None,
+            "goodput_frac_mean": (
+                round(sum(fracs) / len(fracs), 4) if fracs else None
+            ),
+            "worst_goodput_host": worst["host"] if worst else None,
+        },
+    }
+
+
+def pod_trace(host_records: List[Tuple[str, List[dict]]]) -> dict:
+    """One Perfetto timeline with a track per host. Host i's events keep
+    their own layout but move to ``pid=i``; tracks are aligned on the
+    shared wall clock via each host's recovered origin so cross-host
+    skew is visible as displacement."""
+    events: List[dict] = []
+    origins = [
+        _wall_origin(records) for _, records in host_records
+    ]
+    known = [o for o in origins if o is not None]
+    base = min(known) if known else 0.0
+    for i, (name, records) in enumerate(host_records):
+        offset_us = ((origins[i] - base) if origins[i] is not None else 0.0) * 1e6
+        events.append({
+            "name": "process_name", "ph": "M", "pid": i, "tid": 0,
+            "args": {"name": name},
+        })
+        for e in summ.export_trace(records)["traceEvents"]:
+            events.append({
+                **e,
+                "pid": i,
+                "ts": round(float(e.get("ts", 0.0)) + offset_us, 1),
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def format_text(report: dict) -> str:
+    lines = [f"pod report — {report['n_hosts']} host(s)"]
+    w = max([len(h["host"]) for h in report["hosts"]] + [4])
+    cols = [b for b in goodput_lib.ALL_BUCKETS]
+    lines.append("per-host goodput ledgers:")
+    lines.append(
+        f"  {'host'.ljust(w)} {'goodput':>8} {'elapsed':>9} "
+        + " ".join(f"{c[:9]:>9}" for c in cols)
+        + f" {'img/s':>9} {'seg':>4}"
+    )
+
+    def cell(v, spec, width):
+        return (format(v, spec) if isinstance(v, (int, float)) else "-").rjust(width)
+
+    for h in report["hosts"]:
+        gp = h.get("goodput") or {}
+        lines.append(
+            f"  {h['host'].ljust(w)} "
+            f"{cell(gp.get('goodput_frac'), '.1%', 8)} "
+            f"{cell(gp.get('elapsed_s'), '.1f', 9)} "
+            + " ".join(cell(gp.get(f"{c}_s"), ".1f", 9) for c in cols)
+            + f" {cell(h.get('images_per_sec_mean'), '.1f', 9)}"
+            + f" {cell(gp.get('n_segments'), 'd', 4)}"
+        )
+    for s in report.get("epoch_skew", []):
+        mark = " <-- STRAGGLER" if s["skew"] > 1.5 else ""
+        lines.append(
+            f"epoch {s['epoch']}: max/median skew {s['skew']}x "
+            f"(worst {s['worst_host']}: {s['max_s']}s vs median "
+            f"{s['median_s']}s, attribution: {s['attribution']}){mark}"
+        )
+    for hb in report.get("heartbeats", []):
+        if hb.get("status") == "present":
+            lines.append(
+                f"heartbeat {hb['file']}: beat {hb.get('counter')} at epoch "
+                f"{hb.get('epoch')} step {hb.get('step')} phase "
+                f"{hb.get('phase')}, {hb.get('beat_age_s')}s old"
+            )
+        else:
+            lines.append(f"heartbeat {hb['file']}: {hb['status']}")
+    pod = report.get("pod", {})
+    if pod.get("goodput_frac_mean") is not None:
+        lines.append(
+            f"pod goodput: mean {pod['goodput_frac_mean']:.1%}, min "
+            f"{pod['goodput_frac_min']:.1%} "
+            f"({pod['worst_goodput_host']})"
+        )
+    return "\n".join(lines)

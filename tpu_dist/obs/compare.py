@@ -45,7 +45,13 @@ REPORT_METRICS: Tuple[Tuple[str, str, float], ...] = (
     ("mfu_mean", "higher", 0.005),
     ("final_loss", "lower", 0.02),
     ("final_val_top1", "higher", 0.5),
+    ("goodput_frac", "higher", 0.01),
 )
+
+#: the ``--goodput`` gate's metric set: time-to-useful-work only. The
+#: fraction is the headline; the stall fraction rides along because a
+#: goodput regression's most common cause is an input-pipeline change.
+GOODPUT_METRICS: Tuple[str, ...] = ("goodput_frac", "data_stall_frac")
 
 #: bench-mode per-record fields: (field, direction, absolute slack).
 BENCH_FIELDS: Tuple[Tuple[str, str, float], ...] = (
@@ -56,6 +62,7 @@ BENCH_FIELDS: Tuple[Tuple[str, str, float], ...] = (
     ("step_ms_p95", "lower", 0.0),
     ("step_ms_p99", "lower", 0.0),
     ("mfu", "higher", 0.005),
+    ("goodput_frac", "higher", 0.02),
 )
 
 
@@ -72,6 +79,7 @@ def report_scalars(report: dict) -> dict:
         r.get("val_top1") for r in epochs
         if isinstance(r.get("val_top1"), (int, float))
     ]
+    gp = report.get("goodput") or {}
     return {
         "images_per_sec_mean": report["totals"].get("images_per_sec_mean"),
         "step_time_p50_s": _mean([r.get("step_time_p50_s") for r in epochs]),
@@ -81,6 +89,9 @@ def report_scalars(report: dict) -> dict:
         "mfu_mean": report["totals"].get("mfu_mean"),
         "final_loss": losses[-1] if losses else None,
         "final_val_top1": top1s[-1] if top1s else None,
+        # the run-level ledger's fraction (obs/goodput.py): resumed
+        # segments folded, restart gaps counted against it
+        "goodput_frac": gp.get("goodput_frac"),
     }
 
 
@@ -106,10 +117,17 @@ def _row(
     return out
 
 
-def compare_scalars(base: dict, cand: dict, threshold: float = 0.05) -> dict:
+def compare_scalars(
+    base: dict, cand: dict, threshold: float = 0.05,
+    goodput_only: bool = False,
+) -> dict:
+    metrics = [
+        m for m in REPORT_METRICS
+        if not goodput_only or m[0] in GOODPUT_METRICS
+    ]
     rows = [
         _row(key, direction, slack, base.get(key), cand.get(key), threshold)
-        for key, direction, slack in REPORT_METRICS
+        for key, direction, slack in metrics
     ]
     return _result(rows, threshold)
 
@@ -189,10 +207,20 @@ def compare_bench(base: dict, cand: dict, threshold: float = 0.05) -> dict:
 def compare_files(
     baseline: str, candidate: str, *,
     threshold: float = 0.05, bench: bool = False,
+    goodput_only: bool = False,
 ) -> dict:
     """The CLI engine: load both inputs and diff. Raises OSError on an
     unreadable file and ValueError on an unusable one — the caller maps
-    both to exit 2 (a broken gate, distinct from exit 1's regression)."""
+    both to exit 2 (a broken gate, distinct from exit 1's regression).
+    ``goodput_only`` (the ``--goodput`` flag) restricts the gate to the
+    time-to-useful-work metrics; inputs without goodput records then
+    compare nothing, which the CLI surfaces as a broken gate (exit 2)
+    rather than a silent pass."""
+    if bench and goodput_only:
+        raise ValueError(
+            "--goodput gates the history-mode run ledger; bench records "
+            "carry goodput_frac as an ordinary compared field instead"
+        )
     if bench:
         result = compare_bench(
             load_bench_records(baseline), load_bench_records(candidate),
@@ -201,7 +229,7 @@ def compare_files(
     else:
         b = load_history_scalars(baseline)
         c = load_history_scalars(candidate)
-        result = compare_scalars(b, c, threshold)
+        result = compare_scalars(b, c, threshold, goodput_only=goodput_only)
         result["baseline_run_id"] = b.get("_run_id")
         result["candidate_run_id"] = c.get("_run_id")
     result["baseline"] = baseline

@@ -1,13 +1,15 @@
-"""Rank-0 heartbeat file — liveness signal for external watchdogs
+"""Per-process heartbeat files — liveness signal for external watchdogs
 (``docs/observability.md``).
 
 A pod orchestrator watching a training job from outside cannot tell a HUNG
 step (deadlocked collective, dead loader producer) from a SLOW one (big
 compile, cold cache) by looking at the process table — both look like a
-silent process. The heartbeat file answers it: rank 0 rewrites one small
-JSON file at the step grain with a strictly monotonic beat counter plus
-the (epoch, step) position; a watchdog that sees the counter stop
-advancing for N× the recent step time knows the job is wedged, not slow.
+silent process. The heartbeat file answers it: every process rewrites its
+own small JSON file at the step grain (rank 0 the bare ``--heartbeat_file``
+path, rank k ``.h<k>`` — liveness is per-host) with a strictly monotonic
+beat counter plus the (epoch, step) position; a watchdog that sees a
+counter stop advancing for N× the recent step time knows that host is
+wedged, not slow.
 
 Discipline:
 
@@ -32,7 +34,7 @@ from tpu_dist.obs import counters
 
 
 class Heartbeat:
-    """One writer per file (the trainer creates it on rank 0 only)."""
+    """One writer per file (the trainer derives one path per process)."""
 
     def __init__(self, path: str, min_interval: float = 1.0):
         self.path = path
@@ -71,8 +73,9 @@ class Heartbeat:
         }
         tmp = self.path + ".tmp"
         try:
-            # tpu-dist: ignore[TD002,TD007] — rank-0-only by construction
-            # (the trainer creates the Heartbeat on the primary process)
+            # tpu-dist: ignore[TD002,TD007] — deliberately per-process
+            # I/O: each rank owns its own derived heartbeat path, so this
+            # never needs the rank-0 guard the lint looks for
             with open(tmp, "w") as f:
                 json.dump(payload, f)
             os.replace(tmp, self.path)
@@ -88,6 +91,14 @@ class Heartbeat:
                 os.remove(p)
             except FileNotFoundError:
                 pass
+
+
+def per_rank_path(base: str, rank: int) -> str:
+    """The shared per-rank file naming (``--per_host_log`` and heartbeat
+    alike): rank 0 keeps the bare path, rank k appends ``.h<k>``. ONE
+    definition — the launcher's watchdog and ``obs pod`` read exactly the
+    scheme the trainer writes, so the three sites can never drift."""
+    return base if rank == 0 else f"{base}.h{rank}"
 
 
 def read(path: str) -> Optional[dict]:

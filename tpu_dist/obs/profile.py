@@ -1,0 +1,233 @@
+"""Triggered on-device profiler capture (``docs/observability.md``).
+
+``--profile_dir`` alone captures epoch 0 and nothing else — but the step
+you actually want on an XLA timeline is the one where something went
+wrong: the loss spiked, a host straggled, the step silently recompiled.
+By then a whole-run trace would be gigabytes deep. This module keeps the
+profiler DISARMED until a health signal fires, then captures a bounded
+window of steps:
+
+* **Triggers** (``--profile_trigger``): anomaly findings, straggler
+  flags, and mid-run retraces arm a capture; ``auto`` enables all three,
+  a comma list (``anomaly,retrace``) selects. Anomaly/retrace captures
+  run on rank 0; a straggler capture runs on the flagged host — the one
+  whose timeline explains the skew.
+* **Manual** (``--profile_steps a:b``): capture global steps ``[a, b)``
+  unconditionally — the "I know which step is bad" path.
+* **Bounds**: each triggered capture covers ``--profile_window`` steps
+  (a manual capture owns its full ``[a, b)`` range), consecutive
+  captures are separated by ``--profile_cooldown`` steps, and at most
+  ``--profile_max_captures`` triggered captures run per process — an
+  anomaly storm cannot turn the run into one endless trace.
+
+Cost contract: arming a trigger is host bookkeeping only, and even an
+OPEN capture window only observes the program XLA already built — the
+jaxpr-audit rule **TD108** proves the traced step is byte-identical with
+a trigger armed and with a capture in flight (the TD105-TD107
+discipline). Capture failures (no profiler backend, a second trace
+already active) are counted and disable further captures; they must
+never kill the training step that tripped them.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from tpu_dist.obs import counters
+
+#: Trigger kinds ``--profile_trigger`` may name (``auto`` = all three).
+TRIGGER_KINDS = ("anomaly", "straggler", "retrace")
+
+
+def parse_trigger(spec: str) -> frozenset:
+    """``off`` → empty set, ``auto`` → all kinds, else a comma list of
+    :data:`TRIGGER_KINDS`. Raises ValueError on anything else."""
+    spec = (spec or "off").strip().lower()
+    if spec in ("off", ""):
+        return frozenset()
+    if spec == "auto":
+        return frozenset(TRIGGER_KINDS)
+    kinds = frozenset(p.strip() for p in spec.split(",") if p.strip())
+    bad = kinds - frozenset(TRIGGER_KINDS)
+    if bad:
+        raise ValueError(
+            f"unknown profile trigger(s) {sorted(bad)}; use 'off', 'auto', "
+            f"or a comma list of {TRIGGER_KINDS}"
+        )
+    return kinds
+
+
+def parse_steps(spec: Optional[str]) -> Optional[Tuple[int, int]]:
+    """``--profile_steps a:b`` → ``(a, b)`` global-step window ``[a, b)``.
+    Raises ValueError on a malformed or empty range."""
+    if not spec:
+        return None
+    parts = spec.split(":")
+    try:
+        a, b = (int(p) for p in parts)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"--profile_steps must be 'a:b' (global steps, capture [a, b)), "
+            f"got {spec!r}"
+        ) from None
+    if a < 0 or b <= a:
+        raise ValueError(
+            f"--profile_steps needs 0 <= a < b, got {spec!r} (empty window)"
+        )
+    return a, b
+
+
+class TriggeredProfiler:
+    """Bounded ``jax.profiler`` windows armed by health signals.
+
+    The trainer calls :meth:`on_step` once per step (host-side, before
+    dispatch) with the run-global step index; :meth:`arm` is called from
+    the anomaly/straggler/retrace sites. Each capture lands in its own
+    subdirectory of ``out_dir`` (``capture_<n>_s<step>_<reason>``), so a
+    TensorBoard pointed at ``out_dir`` lists every window.
+    """
+
+    def __init__(
+        self,
+        out_dir: str,
+        *,
+        window_steps: int = 8,
+        cooldown_steps: int = 200,
+        max_captures: int = 3,
+        manual_range: Optional[Tuple[int, int]] = None,
+    ):
+        if window_steps < 1:
+            raise ValueError(f"window_steps must be >= 1, got {window_steps}")
+        if cooldown_steps < 0 or max_captures < 0:
+            raise ValueError("cooldown_steps/max_captures must be >= 0")
+        self.out_dir = out_dir
+        self.window_steps = window_steps
+        self.cooldown_steps = cooldown_steps
+        self.max_captures = max_captures
+        self.manual_range = manual_range
+        self.captures = 0            # triggered captures taken (cap applies)
+        self._armed: Optional[str] = None
+        self._active: Optional[dict] = None  # {"reason","start_step","dir"}
+        self._last_stop_step: Optional[int] = None
+        self._last_step: Optional[int] = None  # newest on_step() index seen
+        self._manual_done = False
+        self._broken = False         # a capture failed: no more attempts
+
+    @property
+    def armed(self) -> Optional[str]:
+        return self._armed
+
+    @property
+    def active(self) -> bool:
+        return self._active is not None
+
+    def arm(self, reason: str) -> bool:
+        """Request a capture starting at the next step. No-ops (False)
+        while a capture is in flight, once the capture cap is spent, or
+        after a backend failure."""
+        if self._broken or self._active is not None:
+            return False
+        if self.captures >= self.max_captures:
+            counters.inc("profile.skipped_capped")
+            return False
+        if self._armed is None:
+            counters.inc("profile.armed")
+        self._armed = reason
+        return True
+
+    def on_step(self, step: int) -> Optional[dict]:
+        """Advance the capture state machine at global step ``step``.
+        Returns a ``{"event": "start"|"stop", ...}`` dict when a window
+        opened or closed on this call (the trainer logs it), else None."""
+        self._last_step = step
+        if self._active is not None:
+            # a manual capture owns its FULL [a, b) range — window_steps
+            # bounds triggered captures only
+            if self._active["reason"] == "manual":
+                if self.manual_range is not None and step >= self.manual_range[1]:
+                    return self._stop(step)
+            elif step - self._active["start_step"] >= self.window_steps:
+                return self._stop(step)
+            return None
+        if (
+            self.manual_range is not None
+            and not self._manual_done
+            and self.manual_range[0] <= step < self.manual_range[1]
+        ):
+            self._manual_done = True
+            return self._start(step, "manual")
+        if self._armed is not None:
+            if (
+                self._last_stop_step is not None
+                and step - self._last_stop_step < self.cooldown_steps
+            ):
+                return None  # stays armed; fires when the cooldown expires
+            reason, self._armed = self._armed, None
+            self.captures += 1
+            return self._start(step, reason)
+        return None
+
+    def _start(self, step: int, reason: str) -> Optional[dict]:
+        tag = "".join(
+            c if c.isalnum() or c in "-_" else "_" for c in reason
+        )[:48]
+        n = self.captures if reason != "manual" else "manual"
+        d = os.path.join(self.out_dir, f"capture_{n}_s{step}_{tag}")
+        try:
+            import jax  # noqa: PLC0415
+
+            os.makedirs(d, exist_ok=True)
+            jax.profiler.start_trace(d)
+        except Exception as e:
+            # a second live trace, a missing profiler backend, a full disk:
+            # training outranks forensics — record and stand down for good
+            self._broken = True
+            self._active = None
+            counters.inc("profile.errors")
+            return {"event": "error", "reason": reason, "error": str(e)[:200]}
+        self._active = {"reason": reason, "start_step": step, "dir": d}
+        counters.inc("profile.captures")
+        return {
+            "event": "start", "reason": reason, "step": step, "dir": d,
+            "window_steps": (
+                self.manual_range[1] - self.manual_range[0]
+                if reason == "manual" and self.manual_range is not None
+                else self.window_steps
+            ),
+        }
+
+    def _stop(self, step: int) -> Optional[dict]:
+        info, self._active = self._active, None
+        self._last_stop_step = step
+        try:
+            import jax  # noqa: PLC0415
+
+            jax.profiler.stop_trace()
+        except Exception as e:
+            self._broken = True
+            counters.inc("profile.errors")
+            return {"event": "error", "reason": info["reason"],
+                    "error": str(e)[:200]}
+        return {
+            "event": "stop", "reason": info["reason"],
+            "start_step": info["start_step"], "stop_step": step,
+            "steps": step - info["start_step"], "dir": info["dir"],
+        }
+
+    def close(self) -> Optional[dict]:
+        """Stop any in-flight capture (fit exit, including error exits) —
+        an unterminated trace would hold the profiler lock for the
+        process's life. The stop event reports the steps that actually
+        ran (the newest ``on_step`` index, not the planned window) and is
+        flagged ``aborted`` so the record never overstates coverage."""
+        if self._active is None:
+            return None
+        last = (
+            self._last_step if self._last_step is not None
+            else self._active["start_step"]
+        )
+        ev = self._stop(last + 1)
+        if ev is not None and ev.get("event") == "stop":
+            ev["aborted"] = True  # the run ended inside the window
+        return ev

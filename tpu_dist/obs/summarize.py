@@ -21,6 +21,21 @@ import json
 from typing import List, Optional, Tuple
 
 from tpu_dist.obs import counters as counters_lib
+from tpu_dist.obs import goodput as goodput_lib
+
+#: Newest history schema this reader fully understands
+#: (``metrics/history.py``). Records stamped newer still have their KNOWN
+#: kinds summarized; their unknown kinds are skipped with a count — the
+#: forward-compat contract that lets v3 tooling read v4 logs and vice
+#: versa (every schema bump is additive).
+SUPPORTED_SCHEMA = 4
+
+#: Record kinds this reader folds into the report. Anything else is
+#: counted into ``skipped_kinds`` — never an error, never silent.
+KNOWN_KINDS = frozenset((
+    "train_epoch", "eval", "straggler", "anomaly", "device_stats",
+    "auto_recover", "spans", "goodput", "profile",
+))
 
 
 def load_records(path: str) -> Tuple[List[dict], int]:
@@ -52,6 +67,8 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
     evals = {}
     stragglers = []
     anomalies: List[dict] = []
+    profiles: List[dict] = []
+    goodput_epochs: List[dict] = []
     dstats: dict = {}  # epoch -> per-epoch device_stats aggregate
     recoveries = 0
     prev_counters: Optional[dict] = None
@@ -59,10 +76,20 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
     final_counters: Optional[dict] = None
     run_id = None
     schema = None
+    skipped_kinds: dict = {}       # unknown kind -> count (never silent)
+    newer_schema_records = 0       # records stamped past SUPPORTED_SCHEMA
     for rec in records:
         kind = rec.get("kind")
         run_id = rec.get("run_id", run_id)
-        schema = rec.get("schema_version", schema)
+        sv = rec.get("schema_version")
+        if isinstance(sv, int) and sv > SUPPORTED_SCHEMA:
+            newer_schema_records += 1
+        schema = sv if sv is not None else schema
+        if kind not in KNOWN_KINDS:
+            # a future schema's kind (or a foreign line): skip WITH a
+            # count — the v3 kind set must not be a parsing assumption
+            skipped_kinds[str(kind)] = skipped_kinds.get(str(kind), 0) + 1
+            continue
         rid = rec.get("run_id")
         if rid is not None and rid != prev_run_id:
             # resume boundary (same --log_file, fresh process + counter
@@ -96,6 +123,26 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
                     d[f"{key}_last"] = v
         elif kind == "auto_recover":
             recoveries += 1
+        elif kind == "profile":
+            profiles.append({
+                k: rec.get(k)
+                for k in ("epoch", "event", "reason", "start_step",
+                          "stop_step", "steps", "dir", "error")
+                if rec.get(k) is not None
+            })
+        elif kind == "goodput" and not rec.get("final"):
+            goodput_epochs.append({
+                "epoch": rec.get("epoch"),
+                **({"tail": True} if rec.get("tail") else {}),
+                **{
+                    k: rec.get(k)
+                    for k in (
+                        [f"{b}_s" for b in goodput_lib.ALL_BUCKETS]
+                        + ["window_s"]
+                    )
+                    if isinstance(rec.get(k), (int, float))
+                },
+            })
         if isinstance(rec.get("counters"), dict):
             final_counters = rec["counters"]
         if kind != "train_epoch":
@@ -146,10 +193,17 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
         "schema_version": schema,
         "n_records": len(records),
         "bad_lines": bad_lines,
+        "skipped_kinds": skipped_kinds,
+        "newer_schema_records": newer_schema_records,
         "epochs": epochs,
         "partial_epoch_device_stats": partial,
         "stragglers": stragglers,
         "anomalies": anomalies,
+        "profiles": profiles,
+        "goodput_epochs": goodput_epochs,
+        # run-level goodput ledger: resumed segments folded, restart gaps
+        # attributed to preempt_s (None on a goodput-less / pre-v4 log)
+        "goodput": goodput_lib.run_ledger(records),
         "auto_recoveries": recoveries,
         "totals": {
             "n_epochs": len(epochs),
@@ -175,6 +229,20 @@ def format_text(report: dict) -> str:
         f"{report['n_records']} record(s)"
         + (f", {report['bad_lines']} unparsable line(s)" if report["bad_lines"] else "")
     )
+    skipped = report.get("skipped_kinds") or {}
+    if skipped:
+        body = ", ".join(f"{k}×{v}" for k, v in sorted(skipped.items()))
+        lines.append(
+            f"skipped {sum(skipped.values())} record(s) of unknown kind(s): "
+            f"{body}"
+        )
+    if report.get("newer_schema_records"):
+        lines.append(
+            f"NOTE: {report['newer_schema_records']} record(s) carry a "
+            f"schema version newer than this reader supports "
+            f"({SUPPORTED_SCHEMA}) — known kinds are summarized, the rest "
+            "skipped above"
+        )
     hdr = (
         f"{'epoch':>5} {'img/s':>9} {'epoch_s':>8} {'p50_ms':>8} "
         f"{'p95_ms':>8} {'p99_ms':>8} {'stall%':>7} {'mfu':>6} "
@@ -235,6 +303,49 @@ def format_text(report: dict) -> str:
             f"straggler: epoch {s.get('epoch')} process {s.get('worst_rank')} "
             f"at {s.get('skew')}x median ({s.get('max_s')}s vs {s.get('median_s')}s)"
         )
+    for pr in report.get("profiles", []):
+        if pr.get("event") == "stop":
+            lines.append(
+                f"profile: captured {pr.get('steps')} step(s) from global "
+                f"step {pr.get('start_step')} ({pr.get('reason')}) → "
+                f"{pr.get('dir')}"
+            )
+        elif pr.get("event") == "error":
+            lines.append(
+                f"profile: capture FAILED ({pr.get('reason')}): "
+                f"{pr.get('error')}"
+            )
+    gp_epochs = report.get("goodput_epochs") or []
+    if gp_epochs:
+        lines.append("goodput (seconds per window):")
+        cols = [b for b in goodput_lib.ALL_BUCKETS]
+        lines.append(
+            f"{'epoch':>5} {'window':>8} "
+            + " ".join(f"{c[:10]:>10}" for c in cols)
+        )
+        any_tail = False
+        for g in gp_epochs:
+            ep = g.get("epoch")
+            tail = bool(g.get("tail"))
+            any_tail = any_tail or tail
+            ep_cell = (
+                f"{_fmt(ep, 'd', 4)}*" if isinstance(ep, int) and tail
+                else f"{_fmt(ep, 'd', 5)}" if isinstance(ep, int)
+                else "    -"
+            )
+            lines.append(
+                f"{ep_cell} "
+                f"{_fmt(g.get('window_s'), '.2f', 8)} "
+                + " ".join(_fmt(g.get(f"{c}_s"), ".2f", 10) for c in cols)
+            )
+        if any_tail:
+            lines.append(
+                "  (* run-end tail window: final save / writer drain / "
+                "teardown, not an epoch)"
+            )
+    gp = report.get("goodput")
+    if gp:
+        lines.append(goodput_lib.ledger_line(gp))
     if report["auto_recoveries"]:
         lines.append(f"auto-recoveries: {report['auto_recoveries']}")
     t = report["totals"]

@@ -46,7 +46,12 @@ _NOT_INSTALLED = object()
 
 def _handler(signum, frame):  # noqa: ARG001 — signal-handler signature
     global _REQUESTED
-    _REQUESTED = True  # flag only: nothing else is async-signal-safe
+    # flag write only — CPython runs Python-level handlers between
+    # bytecodes, so this is safe at any interruption point. The goodput
+    # ledger charges the shutdown tail from the cooperative boundary
+    # (Trainer.fit's except site), not from here: time spent REACHING
+    # the boundary stays in the bucket that actually used it.
+    _REQUESTED = True
 
 
 def install():

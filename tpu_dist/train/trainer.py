@@ -48,6 +48,7 @@ from tpu_dist.metrics.profiler import StepTimer
 from tpu_dist.nn import resnet18, resnet34, resnet50
 from tpu_dist.obs import costmodel as costmodel_lib
 from tpu_dist.obs import counters as counters_lib
+from tpu_dist.obs import goodput as goodput_lib
 from tpu_dist.obs import spans as spans_lib
 from tpu_dist.resilience import faults, preemption
 from tpu_dist.resilience.preemption import PreemptedError
@@ -136,8 +137,14 @@ class Trainer:
         # under its fresh run_id — and so the restore ladder's counters
         # (which run during THIS construction, below) attribute to this run
         counters_lib.reset()
+        # the goodput ledger's wall-clock book opens NOW: construction —
+        # the resume restore ladder included — is part of the run it
+        # accounts, and every second from here to fit()'s exit lands in
+        # exactly one bucket (obs/goodput.py)
+        self._goodput = goodput_lib.GoodputLedger()
         # process-lifetime XLA compile-time accounting (compile.seconds):
-        # idempotent, host-side, feeds the registry just reset above
+        # idempotent, host-side, feeds the registry just reset above AND
+        # the ledger's compile bucket (per-epoch counter deltas)
         costmodel_lib.install_compile_listener()
         if cfg.compile_cache_dir:
             # persistent XLA compile cache (VERDICT r1 #8): a rerun of the
@@ -182,6 +189,47 @@ class Trainer:
                 "1/n-sized (the serialization the async thread exists to "
                 "overlap), and the manifest commit needs a cross-process "
                 "barrier that a background thread must not hold"
+            )
+        # triggered on-device profiling (obs/profile.py): both specs are
+        # validated HERE, before any model/data work, so a typo fails in
+        # milliseconds rather than after the loaders built
+        from tpu_dist.obs import profile as profile_lib  # noqa: PLC0415
+
+        self._profile_triggers = profile_lib.parse_trigger(cfg.profile_trigger)
+        manual_profile = profile_lib.parse_steps(cfg.profile_steps)
+        self._profiler = None
+        self._global_step = 0  # run-global step index (--profile_steps grid)
+        if self._profile_triggers or manual_profile:
+            if not cfg.profile_dir:
+                raise ValueError(
+                    "--profile_trigger/--profile_steps capture on-device "
+                    "traces and need --profile_dir for the output "
+                    "(refusing to silently ignore the flags)"
+                )
+            if cfg.fused_epoch:
+                raise ValueError(
+                    "--profile_trigger/--profile_steps need the per-step "
+                    "grain; --fused_epoch compiles the epoch into one "
+                    "call with no step boundary to open/close a capture "
+                    "window at (use --profile_dir alone for the epoch-0 "
+                    "blanket trace)"
+                )
+            import os as _os  # noqa: PLC0415
+
+            out = (
+                _os.path.join(cfg.profile_dir, f"host{mesh_lib.process_index()}")
+                if mesh_lib.process_count() > 1 else cfg.profile_dir
+            )
+            # ctor validates window/cooldown/cap before training starts.
+            # Created on EVERY process: anomaly/retrace triggers arm it on
+            # rank 0 only, a straggler flag arms it on the flagged host —
+            # the one whose timeline explains the skew.
+            self._profiler = profile_lib.TriggeredProfiler(
+                out,
+                window_steps=cfg.profile_window,
+                cooldown_steps=cfg.profile_cooldown,
+                max_captures=cfg.profile_max_captures,
+                manual_range=manual_profile,
             )
         if cfg.pp_interleave < 1:
             raise ValueError(f"pp_interleave must be >= 1, got {cfg.pp_interleave}")
@@ -844,11 +892,27 @@ class Trainer:
         if cfg.resume and cfg.ckpt_dir:
             # template = current state (matches sharded layouts too);
             # raises on a format-mismatched ckpt_dir (_restore_latest)
-            epoch = self._restore_latest()
+            with self._goodput.timed("ckpt"):
+                epoch = self._restore_latest()
             if epoch is not None:
                 # a mid-epoch snapshot re-enters its own epoch at the saved
                 # step; a clean end-of-epoch ckpt starts the next epoch
                 self.start_epoch = epoch if self._resume_step else epoch + 1
+                self._seed_global_step()
+
+    def _seed_global_step(self) -> None:
+        """Re-anchor the ``--profile_steps`` grid after a restore. The
+        grid is RUN-global (the flag's contract: 'global steps'), so a
+        resumed process must not restart it at 0 — a manual window that
+        already ran before the preemption would re-fire aimed at the
+        wrong steps. Per-epoch step count is the loader length capped by
+        ``--steps_per_epoch``, the same bound ``train_epoch`` honors; a
+        window cut short by the preemption resumes mid-range (the
+        profiler captures the remaining overlap)."""
+        n = len(self.train_loader)
+        if self.cfg.steps_per_epoch is not None:
+            n = min(n, self.cfg.steps_per_epoch)
+        self._global_step = self.start_epoch * n + self._resume_step
 
     def _ckpt_io(self):
         """Sync module functions, the sharded writer (``--sharded_ckpt``),
@@ -1132,6 +1196,11 @@ class Trainer:
         phase = {"data": 0.0, "dispatch": 0.0, "fetch": 0.0}
         hb = self._heartbeat
         steps_run = 0
+        # goodput baselines: compile seconds and ckpt time spent DURING
+        # this epoch are attributed to their own buckets and subtracted
+        # out of the epoch's productive remainder (obs/goodput.py)
+        compile_s0 = counters_lib.get("compile.seconds")
+        ckpt_s0 = self._goodput.window_value("ckpt")
 
         def timed_batches(src):
             it = iter(src)
@@ -1156,6 +1225,14 @@ class Trainer:
         ):
             if cfg.steps_per_epoch is not None and step >= cfg.steps_per_epoch:
                 break
+            if self._profiler is not None:
+                # capture state machine BEFORE dispatch, so a window
+                # opened here covers whole steps; host-side bookkeeping
+                # only (TD108 pins that the traced step is unchanged)
+                ev = self._profiler.on_step(self._global_step)
+                if ev is not None:
+                    self._note_profile_event(ev, epoch, step)
+            self._global_step += 1
             t_d = time.perf_counter()
             new_state, metrics = self.train_step(self.state, images, labels, lr)
             d_d = time.perf_counter() - t_d
@@ -1185,6 +1262,13 @@ class Trainer:
                     f"{step} — input shape/dtype drift? (compile.retraces="
                     f"{counters_lib.get('compile.retraces'):g})"
                 )
+                if (
+                    self._profiler is not None
+                    and "retrace" in self._profile_triggers
+                    and mesh_lib.is_primary()
+                ):
+                    # catch the post-retrace steps on the device timeline
+                    self._profiler.arm("retrace")
             self._progress = (new_state, epoch, step + 1, False)
             self.state = new_state
             images_seen += cfg.batch_size
@@ -1226,13 +1310,14 @@ class Trainer:
                         f"mid-epoch snapshot boundary before writing it; "
                         f"restore from ckpt_dir to recover"
                     )
-                self._ckpt_io().save(
-                    cfg.ckpt_dir, new_state, epoch, cfg.keep_last_ckpts,
-                    extra_meta={**self._ckpt_meta(),
-                                "mid_epoch_step": step + 1,
-                                "mid_epoch_batch_size": cfg.batch_size,
-                                "mid_epoch_seed": cfg.seed or 0},
-                )
+                with self._goodput.timed("ckpt"):
+                    self._ckpt_io().save(
+                        cfg.ckpt_dir, new_state, epoch, cfg.keep_last_ckpts,
+                        extra_meta={**self._ckpt_meta(),
+                                    "mid_epoch_step": step + 1,
+                                    "mid_epoch_batch_size": cfg.batch_size,
+                                    "mid_epoch_seed": cfg.seed or 0},
+                    )
             if want_log:
                 if cfg.nan_guard and not np.isfinite(m["loss"]):
                     raise TrainingDivergedError(
@@ -1317,6 +1402,18 @@ class Trainer:
                 out["mfu"] = mfu
                 rank0_print(f"  MFU {mfu:.1%}")
         self._publish_memory_gauges()
+        # goodput attribution for this epoch's wall time: the measured
+        # stall + the compile/ckpt seconds that landed inside it, with the
+        # remainder — the step loop actually stepping — as productive.
+        # The in-epoch remainder definition keeps the ledger's sum-equals-
+        # wall-clock invariant exact instead of approximately true.
+        compile_d = max(counters_lib.get("compile.seconds") - compile_s0, 0.0)
+        ckpt_d = max(self._goodput.window_value("ckpt") - ckpt_s0, 0.0)
+        self._goodput.add("data_stall", phase["data"])
+        self._goodput.add("compile", compile_d)
+        self._goodput.add(
+            "productive", dt - phase["data"] - compile_d - ckpt_d
+        )
         counters_lib.inc("train.epochs")
         counters_lib.inc("train.steps", steps_run)
         return out
@@ -1328,6 +1425,7 @@ class Trainer:
         # back to the previous clean boundary
         self._progress = (self.state, epoch, 0, False)
         lr = self._lr(epoch)
+        compile_s0 = counters_lib.get("compile.seconds")
         t0 = time.time()
         t_pc = time.perf_counter()
         self.state, metrics = self._fused_runner(
@@ -1383,6 +1481,11 @@ class Trainer:
                 rank0_print(f"  MFU {mfu:.1%}")
         self._step_traced = True
         self._publish_memory_gauges()
+        # goodput: device-resident data means no stall bucket; the whole
+        # call minus its compile time is productive step time
+        compile_d = max(counters_lib.get("compile.seconds") - compile_s0, 0.0)
+        self._goodput.add("compile", compile_d)
+        self._goodput.add("productive", dt - compile_d)
         # anomaly detection at the only grain the fused path has (the
         # epoch-mean loss); no per-step norms here — --device_metrics is
         # refused with --fused_epoch at construction
@@ -1483,6 +1586,15 @@ class Trainer:
                 history.log("anomaly", **f)
             counters_lib.inc("anomaly.findings")
             if (
+                self._profiler is not None
+                and "anomaly" in self._profile_triggers
+                and mesh_lib.is_primary()
+            ):
+                # arm a bounded device capture: the NEXT steps — the ones
+                # that explain whether the spike was data or numerics —
+                # land on an XLA timeline (obs/profile.py caps apply)
+                self._profiler.arm(f"anomaly_{f['anomaly']}")
+            if (
                 cfg.anomaly_action == "snapshot"
                 and cfg.ckpt_dir
                 and f["anomaly"] in ("loss_spike", "grad_norm_explosion")
@@ -1510,16 +1622,17 @@ class Trainer:
                 stem = f"anomaly_{epoch}" + (
                     f"_s{step + 1}" if step is not None else ""
                 )
-                if cfg.sharded_ckpt:
-                    ckpt_lib.save_sharded(
-                        cfg.ckpt_dir, self.state, epoch,
-                        extra_meta=extra, stem=stem,
-                    )
-                else:
-                    ckpt_lib.save(
-                        cfg.ckpt_dir, self.state, epoch,
-                        extra_meta=extra, name=f"{stem}.npz",
-                    )
+                with self._goodput.timed("ckpt"):
+                    if cfg.sharded_ckpt:
+                        ckpt_lib.save_sharded(
+                            cfg.ckpt_dir, self.state, epoch,
+                            extra_meta=extra, stem=stem,
+                        )
+                    else:
+                        ckpt_lib.save(
+                            cfg.ckpt_dir, self.state, epoch,
+                            extra_meta=extra, name=f"{stem}.npz",
+                        )
                 counters_lib.inc("anomaly.snapshots")
                 rank0_print(
                     f"=> anomaly snapshot written ({stem}, epoch {epoch}"
@@ -1527,6 +1640,30 @@ class Trainer:
                     + ") — pre-divergence state preserved off the resume "
                     "namespace"
                 )
+
+    def _note_profile_event(self, ev: dict, epoch: int, step) -> None:
+        """A triggered-profiler window opened/closed/failed: rank-0 line +
+        a ``profile`` history record (schema v4), so ``obs summarize`` and
+        the pod report can say WHEN and WHY each capture ran."""
+        if ev.get("event") == "start":
+            rank0_print(
+                f"=> profiler capture started ({ev.get('reason')}) at "
+                f"epoch {epoch} step {step} — {ev.get('window_steps')} "
+                f"step window → {ev.get('dir')}"
+            )
+        elif ev.get("event") == "stop":
+            rank0_print(
+                f"=> profiler capture done ({ev.get('reason')}, "
+                f"{ev.get('steps')} step(s)) → {ev.get('dir')}"
+            )
+        else:
+            rank0_print(
+                f"WARNING: profiler capture failed ({ev.get('reason')}): "
+                f"{ev.get('error')} — triggered profiling disabled for "
+                "this run"
+            )
+        if self._history is not None:
+            self._history.log("profile", epoch=epoch, **ev)
 
     def _apply_step_faults(self, epoch: int, step: int, lr: float) -> None:
         """Host-side --fault_plan actions at the step grain. A matching
@@ -1730,6 +1867,8 @@ class Trainer:
         if epoch is None:
             raise err
         self.start_epoch = epoch if self._resume_step else epoch + 1
+        self._seed_global_step()  # the --profile_steps grid follows the
+        #                           restored (replayed) training position
         self._lr_scale *= cfg.recover_lr_factor
         rank0_print(
             f"=> AUTO-RECOVER: {err}; resumed from epoch {epoch}, LR scale "
@@ -1743,9 +1882,18 @@ class Trainer:
 
         run_id = self._run_id  # stamped at construction (one id per run)
         # rel_s shares the construction-time clock origin with the span
-        # recorder — one timeline for epoch bars and host spans
+        # recorder — one timeline for epoch bars and host spans.
+        # --per_host_log: EVERY process writes its own history (rank 0
+        # keeps the bare path, rank k appends .h<k>) so `obs pod` can
+        # merge per-host goodput ledgers and skew timelines later.
+        log_path = cfg.log_file
+        if cfg.per_host_log and cfg.log_file:
+            from tpu_dist.obs.heartbeat import per_rank_path  # noqa: PLC0415
+
+            log_path = per_rank_path(cfg.log_file, jax.process_index())
         history = MetricsHistory(
-            cfg.log_file, run_id=run_id, t0=self._telemetry_t0
+            log_path, run_id=run_id, t0=self._telemetry_t0,
+            all_processes=cfg.per_host_log,
         )
         # the step loop's health records (device_stats / anomaly) write
         # through this handle; cleared in the finally below so a direct
@@ -1780,10 +1928,19 @@ class Trainer:
                 counters_lib.set_gauge(
                     "comm.grad_wire_bytes_per_step", 2 * bpe * n_params
                 )
-        if cfg.heartbeat_file and mesh_lib.is_primary():
-            from tpu_dist.obs.heartbeat import Heartbeat  # noqa: PLC0415
+        if cfg.heartbeat_file:
+            from tpu_dist.obs.heartbeat import (  # noqa: PLC0415
+                Heartbeat, per_rank_path,
+            )
 
-            self._heartbeat = Heartbeat(cfg.heartbeat_file)
+            # EVERY process beats its own file (per_rank_path: rank 0 the
+            # bare path, rank k .h<k> — the --per_host_log naming):
+            # liveness is per-host, and a watchdog that only sees rank 0
+            # would kill healthy workers / miss a wedged rank 3. The
+            # launcher's --heartbeat_dir watchdog reads the same scheme.
+            self._heartbeat = Heartbeat(
+                per_rank_path(cfg.heartbeat_file, jax.process_index())
+            )
             self._heartbeat.beat(
                 epoch=self.start_epoch, phase="start", force=True
             )
@@ -1818,7 +1975,8 @@ class Trainer:
                     if attempts <= 0:
                         raise
                     attempts -= 1
-                    self._auto_recover(e)  # raises e when no ckpt to load
+                    with self._goodput.timed("recovery"):
+                        self._auto_recover(e)  # raises e when no ckpt to load
                     history.log(
                         "auto_recover", epoch=self._last_epoch,
                         lr_scale=self._lr_scale,
@@ -1829,6 +1987,15 @@ class Trainer:
             # PREEMPTION_EXIT_CODE so the launcher/orchestrator can requeue
             if isinstance(e, PreemptedError):
                 counters_lib.inc("preemption.observed")
+            # preemption/interrupt-loss accounting: the shutdown tail this
+            # process spends honoring the signal (position beat + emergency
+            # snapshot), measured from HERE — time between the SIGTERM and
+            # the cooperative boundary stays in the bucket that actually
+            # used it (finishing the step/eval, or unattributed for a
+            # partial epoch), so the ledger's sum-equals-wall-clock
+            # invariant holds with no double count. The restart gap is the
+            # offline half (obs/goodput.py run_ledger).
+            t_pre = time.monotonic()
             if self._heartbeat is not None:
                 # last beat marks the position; the file is deliberately
                 # NOT swept — a watchdog seeing it + the exit code knows
@@ -1837,15 +2004,23 @@ class Trainer:
                     epoch=self._last_epoch, phase="preempted", force=True
                 )
             self._emergency_save()
+            self._goodput.add("preempt", time.monotonic() - t_pre)
             raise
         finally:
             # error exits (divergence, interrupt): still drain in-flight
             # writes, but log writer failures rather than mask the
             # propagating exception
             preemption.restore(sig_token)
-            self._ckpt_close(suppress=True)
+            with self._goodput.timed("ckpt"):  # async-writer drain is ckpt time
+                self._ckpt_close(suppress=True)
+            if self._profiler is not None:
+                # an in-flight capture window must not outlive the run
+                ev = self._profiler.close()
+                if ev is not None:
+                    self._note_profile_event(ev, self._last_epoch, None)
             if self._tb is not None:
                 self._tb.close()
+            self._close_goodput(history)
             if telemetry:
                 self._export_telemetry(history)
             self._history = None
@@ -1984,6 +2159,26 @@ class Trainer:
              f"{cfg.ckpt_dir} as epoch {prev} — resume re-runs epoch "
              f"{epoch}")
 
+    def _close_goodput(self, history) -> None:
+        """Run-end ledger bookkeeping: fold the tail window (final save,
+        drain, teardown preamble), write the ``final`` totals record, and
+        print the rank-0 ledger line. Best-effort like the rest of the
+        telemetry teardown — the books must never mask a propagating
+        training error."""
+        try:
+            tail = self._goodput.window_record()
+            totals = self._goodput.run_totals()
+            if history.path:
+                # tail=True distinguishes this teardown window from the
+                # per-epoch window logged under the same epoch number
+                history.log("goodput", epoch=self._last_epoch, tail=True,
+                            **tail)
+                history.log("goodput", final=True, **totals)
+            if history.path or self.cfg.trace_file:
+                rank0_print("=> " + goodput_lib.ledger_line(totals))
+        except OSError as e:
+            rank0_print(f"WARNING: goodput ledger close failed: {e}")
+
     def _export_telemetry(self, history) -> None:
         """End-of-run span disposal (rank 0 — fit() arms telemetry there
         only): drain the tail into the JSONL history, write --trace_file,
@@ -2040,7 +2235,13 @@ class Trainer:
             # or the previous epoch's completion) until train_epoch's own
             # publish — every interrupt window reads a consistent position.
             start_step, self._resume_step = self._resume_step, 0
-            if cfg.profile_dir and epoch == self.start_epoch:
+            # the epoch-0 blanket trace only when triggered/manual capture
+            # does NOT own --profile_dir (two live jax.profiler traces
+            # cannot nest)
+            if (
+                cfg.profile_dir and epoch == self.start_epoch
+                and self._profiler is None
+            ):
                 from tpu_dist.metrics.profiler import trace  # noqa: PLC0415
 
                 with trace(cfg.profile_dir):
@@ -2066,30 +2267,40 @@ class Trainer:
                 )
                 if srec["straggler"]:
                     history.log("straggler", epoch=epoch, **srec)
+                    if (
+                        self._profiler is not None
+                        and "straggler" in self._profile_triggers
+                        and mesh_lib.process_index() == srec["worst_rank"]
+                    ):
+                        # the FLAGGED host arms: its next-epoch steps are
+                        # the timeline that explains the skew (rank 0's
+                        # would just show it waiting at the collective)
+                        self._profiler.arm("straggler")
             if self._tb is not None:
                 for k in ("loss", "acc1", "acc5", "images_per_sec", "mfu"):
                     if k in last:
                         self._tb.add_scalar(f"train/{k}", last[k], epoch)
                 self._tb.add_scalar("train/lr", self._lr(epoch), epoch)
             if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
-                if self._fused_runner is not None:
-                    t_ev = time.perf_counter()
-                    sums = _fetch_metrics(
-                        self._fused_eval(self.state, *self._fused_test_data)
-                    )
-                    spans_lib.add_event(
-                        "eval/fused", t_ev, time.perf_counter() - t_ev,
-                        epoch=epoch,
-                    )
-                    n = max(sums["count"], 1.0)
-                    t1 = sums["top1"] / n * 100.0
-                    t5 = sums["top5"] / n * 100.0
-                    vloss = sums["loss"] / n
-                    rank0_print(f" * Acc@1 {t1:.3f} Acc@5 {t5:.3f} (epoch {epoch}, fused)")
-                else:
-                    t1, t5, vloss = validate(
-                        self.test_loader, self.state, self.eval_step, epoch=epoch
-                    )
+                with self._goodput.timed("eval"):
+                    if self._fused_runner is not None:
+                        t_ev = time.perf_counter()
+                        sums = _fetch_metrics(
+                            self._fused_eval(self.state, *self._fused_test_data)
+                        )
+                        spans_lib.add_event(
+                            "eval/fused", t_ev, time.perf_counter() - t_ev,
+                            epoch=epoch,
+                        )
+                        n = max(sums["count"], 1.0)
+                        t1 = sums["top1"] / n * 100.0
+                        t5 = sums["top5"] / n * 100.0
+                        vloss = sums["loss"] / n
+                        rank0_print(f" * Acc@1 {t1:.3f} Acc@5 {t5:.3f} (epoch {epoch}, fused)")
+                    else:
+                        t1, t5, vloss = validate(
+                            self.test_loader, self.state, self.eval_step, epoch=epoch
+                        )
                 last.update(val_top1=t1, val_top5=t5, val_loss=vloss)
                 history.log("eval", epoch=epoch, top1=t1, top5=t5, loss=vloss)
                 if self._tb is not None:
@@ -2098,10 +2309,11 @@ class Trainer:
                     self._tb.add_scalar("eval/loss", vloss, epoch)
                 if cfg.ckpt_dir and t1 > self._best_top1:
                     self._best_top1 = t1
-                    self._ckpt_io().save_best(
-                        cfg.ckpt_dir, self.state, epoch, t1,
-                        extra_meta=self._ckpt_meta(),
-                    )
+                    with self._goodput.timed("ckpt"):
+                        self._ckpt_io().save_best(
+                            cfg.ckpt_dir, self.state, epoch, t1,
+                            extra_meta=self._ckpt_meta(),
+                        )
             if cfg.ckpt_dir and (
                 (epoch + 1) % cfg.save_every == 0
                 # with periodic mid-epoch snapshots on, EVERY epoch end
@@ -2110,9 +2322,17 @@ class Trainer:
                 # and the "at most N steps lost" guarantee breaks
                 or cfg.mid_epoch_save_every > 0
             ):
-                self._ckpt_io().save(
-                    cfg.ckpt_dir, self.state, epoch, cfg.keep_last_ckpts,
-                    extra_meta=self._ckpt_meta(),
+                with self._goodput.timed("ckpt"):
+                    self._ckpt_io().save(
+                        cfg.ckpt_dir, self.state, epoch, cfg.keep_last_ckpts,
+                        extra_meta=self._ckpt_meta(),
+                    )
+            # close this epoch's goodput window (train + eval + save):
+            # one v4 record per epoch; the records chain, partitioning the
+            # run's wall-clock exactly (obs/goodput.py)
+            if history.path:
+                history.log(
+                    "goodput", epoch=epoch, **self._goodput.window_record()
                 )
             if preemption.requested():
                 # SIGTERM during eval/save lands here: the epoch is complete
@@ -2122,8 +2342,9 @@ class Trainer:
                     f"shutting down at the epoch boundary"
                 )
         if cfg.ckpt_dir:
-            self._ckpt_io().save(
-                cfg.ckpt_dir, self.state, epochs - 1, cfg.keep_last_ckpts,
-                extra_meta=self._ckpt_meta(),
-            )
+            with self._goodput.timed("ckpt"):
+                self._ckpt_io().save(
+                    cfg.ckpt_dir, self.state, epochs - 1, cfg.keep_last_ckpts,
+                    extra_meta=self._ckpt_meta(),
+                )
         return last  # fit() drains the async writer before returning
