@@ -1,6 +1,6 @@
 # Convenience targets; CI / the driver call the underlying commands directly.
 
-.PHONY: test quick bench csrc clean lint pod-report
+.PHONY: test quick bench csrc clean lint pod-report monitor
 
 csrc:
 	$(MAKE) -C tpu_dist/csrc
@@ -26,6 +26,13 @@ bench:
 # and optionally one merged Perfetto timeline)
 pod-report:
 	python -m tpu_dist.obs pod $(LOGS) $(if $(TRACE),--trace-out $(TRACE))
+
+# Follow a LIVE run from another terminal:
+#   make monitor LOG=run.jsonl [HB=hb.json]
+# (docs/observability.md "obs tail" — rolling epoch table, live alert/
+# anomaly/straggler lines, heartbeat staleness)
+monitor:
+	python -m tpu_dist.obs tail $(LOG) $(if $(HB),--heartbeat $(HB))
 
 clean:
 	$(MAKE) -C tpu_dist/csrc clean

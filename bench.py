@@ -34,6 +34,28 @@ BASELINE_IMG_PER_SEC = 50_000 / 14.5  # DDP+apex, 4x2080Ti (README.md:77)
 CIFAR_TRAIN = 50_000
 
 
+def _capture_fingerprint() -> dict:
+    """One fingerprint per bench PROCESS (hostname + random id), stamped
+    with a monotonic capture time into every emitted record. Two records
+    carrying the SAME fingerprint are the same physical capture: a
+    later artifact re-emitting it byte-identically is a stale copy, not
+    a fresh measurement — exactly the r03–r05 failure mode BENCH_NOTES
+    documents, which ``obs compare --bench`` / ``obs summarize --bench``
+    now flag as STALE instead of reporting as fresh."""
+    import socket  # noqa: PLC0415
+    import uuid  # noqa: PLC0415
+
+    return {"host": socket.gethostname(), "bench_run_id": uuid.uuid4().hex[:12]}
+
+
+_CAPTURE = _capture_fingerprint()
+
+
+def _stamped(rec: dict) -> dict:
+    rec["capture"] = {**_CAPTURE, "mono_s": round(time.monotonic(), 3)}
+    return rec
+
+
 def _costmodel():
     """The shared cost/MFU layer (``tpu_dist.obs.costmodel``) — ONE home
     for the chip-peak table, the ``cost_analysis()`` normalization, and
@@ -290,7 +312,7 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None,
         out["grad_compression"] = grad_compression
     if wire is not None:
         out["wire_bytes_per_step"] = wire
-    return out
+    return _stamped(out)
 
 
 def _run_fused(cfg: BenchConfig, mesh, model, optimizer, state, n_dev: int,
@@ -379,7 +401,7 @@ def _run_fused(cfg: BenchConfig, mesh, model, optimizer, state, n_dev: int,
         out["grad_compression"] = grad_compression
     if wire is not None:
         out["wire_bytes_per_step"] = wire
-    return out
+    return _stamped(out)
 
 
 def run_attn(seq_len: int, steps: int, warmup: int, *, batch: int = 0,
@@ -445,7 +467,7 @@ def run_attn(seq_len: int, steps: int, warmup: int, *, batch: int = 0,
     if causal:
         flops /= 2
     tok_per_sec = round(batch * seq_len / flash_s, 1) if flash_s else None
-    return {
+    return _stamped({
         "metric": f"attn_s{seq_len}{'_causal' if causal else ''}_flash_fwd_bwd",
         "value": tok_per_sec,
         "unit": "tokens/sec",
@@ -464,7 +486,7 @@ def run_attn(seq_len: int, steps: int, warmup: int, *, batch: int = 0,
         "xla_err": xla_err,
         "mfu": _mfu(flops, flash_s, 1) if flash_s else None,
         "xla_mfu": _mfu(flops, xla_s, 1) if xla_s else None,
-    }
+    })
 
 
 def run_pp(cfg: BenchConfig, steps: int, warmup: int, pp: int,
@@ -558,7 +580,7 @@ def run_pp(cfg: BenchConfig, steps: int, warmup: int, pp: int,
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
     img_per_sec = batch * steps / dt
-    return {
+    return _stamped({
         "metric": (
             f"{cfg.name}_pp{pp}x{interleave}_m{m}"
             + ("_tiny" if dims == "tiny" else "")
@@ -576,7 +598,7 @@ def run_pp(cfg: BenchConfig, steps: int, warmup: int, pp: int,
         "step_ms": round(1000 * dt / steps, 2),
         "mfu": _mfu(flops, dt / steps, n),
         "goodput_frac": round(dt / (time.perf_counter() - t_bench0), 4),
-    }
+    })
 
 
 def _guarded_backend_init(timeout_s: float, default_invocation: bool = False) -> None:

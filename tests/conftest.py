@@ -38,7 +38,17 @@ def pytest_configure(config):
         "markers", "quick: fast cross-component smoke slice (pytest -m quick)"
     )
     # slow = multi-minute statistical/convergence runs, excluded from the
-    # tier-1 gate (which runs with -m 'not slow' under a hard timeout)
+    # tier-1 gate (which runs with -m 'not slow' under a hard timeout).
+    #
+    # TIER-1 TIME BUDGET: the gate is `timeout -k 10 870` around the whole
+    # 'not slow' suite (ROADMAP.md "Tier-1 verify") — the suite must stay
+    # comfortably under 870 s wall on one CPU host or the timeout TRUNCATES
+    # it mid-alphabet and the gate reads as a pass over a partial run.
+    # When a PR pushes the wall time near the limit, re-mark its heaviest
+    # e2e tests `slow` AND make sure their module runs in a CI step without
+    # the slow filter (.github/workflows/analysis.yml), so coverage moves
+    # to CI instead of silently vanishing. PR 6 overran (~917 s); PR 7
+    # moved ~60 s of e2e into `slow` to restore margin.
     config.addinivalue_line(
         "markers", "slow: multi-minute runs excluded from the tier-1 gate"
     )
@@ -61,6 +71,7 @@ _QUICK = (
     "test_native_pipeline.py", "test_tensorboard.py",
     "test_launch_and_history.py", "test_fused_sgd.py", "test_observability.py",
     "test_obs.py", "test_device_health.py", "test_goodput.py",
+    "test_export.py",
     "test_models.py::test_param_count_parity[resnet18",
     "test_models.py::test_eval_uses_running_stats",
     "test_vit.py::test_vit_forward_shape",

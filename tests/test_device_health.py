@@ -545,6 +545,8 @@ def _tiny_cfg(**kw):
     return TrainConfig(**base)
 
 
+@pytest.mark.slow  # ~4 s (several Trainer constructions); CI device-
+# health step runs it without the slow filter (ISSUE 7 tier-1 budget)
 def test_trainer_refuses_device_metrics_on_excluded_engines(tmp_path):
     from tpu_dist.train.trainer import Trainer
 

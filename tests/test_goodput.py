@@ -304,6 +304,9 @@ def test_seed_global_step_reanchors_profile_grid():
 # -- TD108 -------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~20 s: opens a REAL jax.profiler capture window
+# (the capture-OPEN trace comparison); excluded from the timed tier-1
+# gate, runs in the CI goodput step (no slow filter) — ISSUE 7 budget
 def test_td108_profile_trigger_noop_gate():
     from tpu_dist.analysis.jaxpr_audit import profile_trigger_noop_violations
 
@@ -320,7 +323,7 @@ def test_td108_rule_registered():
 
 
 def test_summarize_skips_unknown_kinds_with_count():
-    """The mixed v3/v4(/v5) regression: older tooling reading a newer log
+    """The mixed v4/v5(/v6) regression: older tooling reading a newer log
     (and vice versa) must skip-with-count, not crash or silently drop."""
     records = [
         {"kind": "train_epoch", "epoch": 0, "run_id": "r", "ts": 1.0,
@@ -329,9 +332,9 @@ def test_summarize_skips_unknown_kinds_with_count():
         _goodput_rec("r", 2.0, 2.0, epoch=0, window_s=2.0,
                      productive_s=1.5, unattributed_s=0.5),
         # a future schema's record kinds: skipped, counted, noted
-        {"kind": "hologram", "epoch": 0, "schema_version": 5, "ts": 3.0},
-        {"kind": "hologram", "epoch": 1, "schema_version": 5, "ts": 4.0},
-        {"kind": "quantum_foam", "schema_version": 5, "ts": 5.0},
+        {"kind": "hologram", "epoch": 0, "schema_version": 6, "ts": 3.0},
+        {"kind": "hologram", "epoch": 1, "schema_version": 6, "ts": 4.0},
+        {"kind": "quantum_foam", "schema_version": 6, "ts": 5.0},
     ]
     report = summarize(records)
     assert report["skipped_kinds"] == {"hologram": 2, "quantum_foam": 1}
@@ -510,6 +513,8 @@ def test_pod_cli_merges_logs_and_writes_trace(tmp_path, capsys):
 # -- launcher heartbeat watchdog ---------------------------------------------
 
 
+@pytest.mark.slow  # real multi-second watchdog waits; CI goodput step
+# runs it without the slow filter (ISSUE 7 tier-1 budget)
 def test_launch_watchdog_detects_and_kills_wedged_worker(tmp_path, capsys):
     """A worker that beats once then hangs (no crash, no preemption) must
     be detected, attributed to its position, and terminated — the
@@ -551,6 +556,8 @@ def test_per_rank_path_one_scheme_for_all_sites():
     assert per_rank_path("/d/hb.json", 3) == "/d/hb.json.h3"
 
 
+@pytest.mark.slow  # ~6 s of real emergency-save sleeps; CI goodput
+# step runs it without the slow filter (ISSUE 7 tier-1 budget)
 def test_launch_watchdog_stands_down_during_preemption(tmp_path, capsys):
     """A preemption shutdown beats once ('preempted') then goes silent in
     the emergency save BY DESIGN — the watchdog must not reclassify that

@@ -240,7 +240,7 @@ def test_history_schema_run_id_rel_s_and_counters(tmp_path):
     lines = [json.loads(l) for l in open(path)]
     assert len(lines) == 2
     for rec in lines:
-        assert rec["schema_version"] == 4  # v4: goodput/profile fleet layer
+        assert rec["schema_version"] == 5  # v5: alert live layer (ISSUE 7)
         assert rec["run_id"] == "cfg1234-99"
         assert isinstance(rec["rel_s"], float) and rec["rel_s"] >= 0
         assert "ts" in rec
@@ -460,6 +460,8 @@ def test_trainer_fetch_count_unchanged_by_telemetry(tmp_path, monkeypatch):
 # -- e2e: acceptance run ----------------------------------------------------
 
 
+@pytest.mark.slow  # ~10 s full-fit e2e; CI observability step runs it
+# without the slow filter (ISSUE 7 tier-1 budget)
 def test_e2e_short_run_summarize_reports_everything(tmp_path, capsys):
     """The acceptance path: a short CPU run with --log_file, then
     `python -m tpu_dist.obs summarize` reports per-epoch throughput,

@@ -82,6 +82,8 @@ def test_trainer_profile_dir_captures_trace(tmp_path):
     assert captures, f"no xplane capture under {prof}"
 
 
+@pytest.mark.slow  # ~13 s (two full fits); CI observability step runs
+# it without the slow filter (ISSUE 7 tier-1 budget)
 def test_loader_num_workers_prefetch_depth():
     """--num_workers maps to the loader's prefetch depth; training is
     unaffected by its value (same batches, same order)."""

@@ -769,13 +769,110 @@ def profile_trigger_noop_violations(mesh=None) -> list[Violation]:
     return out
 
 
+def live_export_noop_violations(mesh=None) -> list[Violation]:
+    """TD109: the live-telemetry cost contract, checked at the program
+    level (the TD105-TD108 armed-vs-off discipline applied to
+    ``obs/export.py`` + ``obs/alerts.py``) — trace the data-parallel
+    step with nothing armed, then arm the FULL live kit: a
+    :class:`MetricsExporter` with a real textfile AND a live HTTP
+    ``/metrics`` thread serving scrapes, fed a real exposition, plus an
+    :class:`AlertEngine` over the built-in rule library observing
+    windows and actually FIRING (a sustained stall-fraction breach, the
+    exact acceptance scenario) — and trace again. The two jaxprs must be
+    byte-identical: exporting and alerting are host-side string/float
+    work on values the trainer already holds, and the moment someone
+    routes a threshold check or a gauge through the traced step, this
+    trips."""
+    import os
+    import shutil
+    import tempfile
+    import urllib.request
+
+    import jax
+
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.obs import alerts as alerts_lib
+    from tpu_dist.obs.export import MetricsExporter
+
+    m = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+    fn, args = _dp_setup(m)
+    base = str(jax.make_jaxpr(fn)(*args))
+    tmp = tempfile.mkdtemp(prefix="td109_export_")
+    exporter = None
+    try:
+        engine = alerts_lib.AlertEngine(alerts_lib.load_rules("default"))
+        # sustain the stall-frac breach until the rule FIRES — the engine
+        # under test must be in its fired state, not just constructed
+        fired = []
+        for _ in range(3):
+            fired.extend(engine.observe({"data_stall_frac": 0.9, "mfu": 0.8}))
+        try:
+            exporter = MetricsExporter(
+                textfile=os.path.join(tmp, "metrics.prom"), port=0, rank=0
+            )
+        except OSError:
+            # no socket allowed in this sandbox: the textfile half still
+            # arms; the scrape below just won't run
+            exporter = MetricsExporter(
+                textfile=os.path.join(tmp, "metrics.prom"), rank=0
+            )
+        exporter.update(
+            {"train.data_stall_frac": 0.9, "train.steps": 42},
+            {"alert_active": engine.active()},
+            force=True,
+        )
+        if exporter.port:
+            # a live scrape against the serving thread, mid-audit
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{exporter.port}/metrics", timeout=5
+            ) as resp:
+                resp.read()
+        fn2, args2 = _dp_setup(m)
+        armed = str(jax.make_jaxpr(fn2)(*args2))
+        probe_ok = bool(fired) and bool(engine.active().get("stall_high"))
+    finally:
+        if exporter is not None:
+            exporter.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+    out: list[Violation] = []
+    if not probe_ok:
+        out.append(
+            Violation(
+                "TD109",
+                "<jaxpr:dp_live_export_noop>",
+                0,
+                "the TD109 probe could not put the alert engine into its "
+                "fired state (the built-in stall_high rule did not fire "
+                "on a sustained breach) — the armed-vs-off comparison "
+                "would be vacuous; the alert state machine drifted",
+                snippet="alert probe did not fire",
+            )
+        )
+    if base != armed:
+        out.append(
+            Violation(
+                "TD109",
+                "<jaxpr:dp_live_export_noop>",
+                0,
+                "the traced train step CHANGED when the live exporter + "
+                "alert engine were armed (exposition published, HTTP "
+                "endpoint scraped, rules fired) — live telemetry must "
+                "stay host-side (obs/export.py + obs/alerts.py contract, "
+                "docs/observability.md)",
+                snippet="jaxpr(live_off) != jaxpr(live_armed)",
+            )
+        )
+    return out
+
+
 def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     """Run every (or the named) registered case. Returns
     ``(report, violations)`` where report maps case → op counts.
     Cross-case TD104 wire-ratio checks run over whichever quantized/
     reference pairs the report contains; full (unfiltered) runs also check
-    the TD105 fault-injection, TD106 telemetry, TD107 device-metrics, and
-    TD108 profiler-trigger no-op invariants."""
+    the TD105 fault-injection, TD106 telemetry, TD107 device-metrics,
+    TD108 profiler-trigger, and TD109 live-export/alerting no-op
+    invariants."""
     report: dict = {}
     violations: list[Violation] = []
     for name in names if names is not None else registered_cases():
@@ -795,6 +892,9 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
         violations.extend(vs)
         vs = profile_trigger_noop_violations(mesh)
         report["dp_profile_trigger_noop"] = {"identical": not vs}
+        violations.extend(vs)
+        vs = live_export_noop_violations(mesh)
+        report["dp_live_export_noop"] = {"identical": not vs}
         violations.extend(vs)
     return report, violations
 

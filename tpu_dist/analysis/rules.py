@@ -139,6 +139,15 @@ RULES: dict[str, Rule] = {
             "the unmodified step; obs/profile.py contract)",
         ),
         Rule(
+            "TD109",
+            "live-export-not-noop",
+            "the traced train step differs between live telemetry OFF and "
+            "an armed OpenMetrics exporter + alert engine (exposition "
+            "published, /metrics scraped, threshold rules fired) — live "
+            "export and alerting must stay host-side (obs/export.py + "
+            "obs/alerts.py contract)",
+        ),
+        Rule(
             "TD104",
             "quantized-wire-bytes-over-budget",
             "gradient-collective payload bytes of a quantized wire format "

@@ -38,7 +38,14 @@ still alive is WEDGED — a deadlocked collective or dead loader, which no
 exit code will ever report — and the launcher says which host stalled, in
 which phase and at which position, counts the stall as goodput loss, and
 terminates it (SIGTERM, then SIGKILL after ``--watchdog_grace``) instead
-of waiting forever. A watchdog kill is a failure, not a preemption: the
+of waiting forever. With ``--metrics_dir`` the launcher additionally
+injects one base ``--metrics_file`` into every child (per-rank derived
+paths, the heartbeat scheme) and the watchdog SCRAPES the wedged
+worker's last OpenMetrics exposition on the way to killing it — so the
+report says not just that the heartbeat froze but WHY the worker was
+sick: last epoch, data-stall fraction, MFU, goodput fraction, and which
+alert rules were active (docs/observability.md "Live export"). A
+watchdog kill is a failure, not a preemption: the
 launcher exits nonzero even if the dying child manages its graceful
 exit-75, because requeueing a deterministic wedge would loop the
 orchestrator on it forever. Size the timeout above the worst cold-compile
@@ -84,6 +91,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
              "path, rank k .h<k>) and watch the files for liveness",
     )
     p.add_argument(
+        "--metrics_dir", default=None,
+        help="inject --metrics_file <dir>/metrics.prom into every child "
+             "(per-rank derived paths, like the heartbeat) so the "
+             "watchdog can scrape a wedged worker's last exposition and "
+             "report WHY it was sick, not just that its beat froze",
+    )
+    p.add_argument(
         "--watchdog_timeout", type=float, default=0.0, metavar="S",
         help="with --heartbeat_dir: a child whose heartbeat counter "
              "stops advancing for S seconds while the process lives is "
@@ -115,6 +129,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # per-rank file from it (heartbeat.per_rank_path — rank 0 = bare
         # path, rank k = .h<k>), and the watchdog reads the same scheme
         hb_base = os.path.join(args.heartbeat_dir, "hb.json")
+    metrics_base = None
+    if args.metrics_dir:
+        os.makedirs(args.metrics_dir, exist_ok=True)
+        # same per-rank scheme as the heartbeat: the trainer derives
+        # .h<k> textfiles and the watchdog scrapes them back
+        metrics_base = os.path.join(args.metrics_dir, "metrics.prom")
 
     procs: List[subprocess.Popen] = []
     ranks: Dict[subprocess.Popen, int] = {}
@@ -153,6 +173,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ]
             if hb_base is not None:
                 child += ["--heartbeat_file", hb_base]
+            if metrics_base is not None:
+                child += ["--metrics_file", metrics_base]
             pr = subprocess.Popen(child, env=env)
             procs.append(pr)
             ranks[pr] = rank
@@ -169,6 +191,48 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         wd_seen: Dict[int, tuple] = {ranks[pr]: (None, now) for pr in procs}
         wd_kill_at: Dict[int, float] = {}
         watchdog = args.watchdog_timeout > 0
+
+        def _sick_report(rank: int) -> str:
+            """WHY the wedged worker was sick: its last OpenMetrics
+            exposition (the exporter leaves the textfile behind exactly
+            for this read). Empty string when nothing is scrapeable —
+            the watchdog's heartbeat-only report still stands."""
+            if metrics_base is None:
+                return ""
+            from tpu_dist.obs import export as export_lib  # noqa: PLC0415
+            from tpu_dist.obs import heartbeat as heartbeat_lib  # noqa: PLC0415
+
+            vals = export_lib.scrape(
+                textfile=heartbeat_lib.per_rank_path(metrics_base, rank)
+            )
+            if not vals:
+                return ""
+
+            def gauge(raw):
+                return vals.get(export_lib.metric_name(raw))
+
+            parts = []
+            for raw, label, spec in (
+                ("train.epoch", "epoch", "g"),
+                ("train.data_stall_frac", "stall", ".1%"),
+                ("train.mfu", "mfu", ".3f"),
+                ("goodput.goodput_frac", "goodput", ".1%"),
+                ("compile.retraces", "retraces", "g"),
+            ):
+                v = gauge(raw)
+                if v is not None:
+                    parts.append(f"{label} {format(v, spec)}")
+            active_prefix = export_lib.metric_name("alert_active") + "{"
+            active = sorted(
+                name[len(active_prefix):].split('"')[1]
+                for name, v in vals.items()
+                if name.startswith(active_prefix) and v
+            )
+            if active:
+                parts.append(f"active alerts: {', '.join(active)}")
+            return (
+                f"; last exposition: {', '.join(parts)}" if parts else ""
+            )
 
         def _watch(pr) -> None:
             nonlocal crash_rc
@@ -208,7 +272,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(
                 f"launch: WATCHDOG: worker {rank} wedged — heartbeat "
                 f"stalled {stalled:.0f}s at {where}; terminating "
-                f"(~{stalled:.0f}s goodput loss on this host)",
+                f"(~{stalled:.0f}s goodput loss on this host)"
+                + _sick_report(rank),
                 file=sys.stderr, flush=True,
             )
             if crash_rc == 0:

@@ -128,6 +128,23 @@ class TrainConfig:
     anomaly_loss_spike: float = 3.0   # loss > X * rolling median => anomaly
     anomaly_grad_spike: float = 10.0  # grad_norm > X * rolling median
                                    # (needs --device_metrics for the norm)
+    metrics_file: Optional[str] = None  # live OpenMetrics textfile
+                                   # (node-exporter textfile-collector
+                                   # format), written atomically at the
+                                   # heartbeat's step-grain throttle;
+                                   # per-rank derived path like the
+                                   # heartbeat (obs/export.py)
+    metrics_port: int = 0          # rank-0-only background HTTP /metrics
+                                   # endpoint serving the last rendered
+                                   # snapshot (never touches jax state
+                                   # from the serving thread); 0 disables
+    alert_rules: Optional[str] = None  # declarative threshold alerting:
+                                   # 'default' (built-in library) or a
+                                   # TOML/JSON rule-spec path — fired
+                                   # rules emit 'alert' history records,
+                                   # rank-0 warnings, exporter gauge
+                                   # flips, and optionally arm the
+                                   # triggered profiler (obs/alerts.py)
     per_host_log: bool = False     # every process writes its own JSONL
                                    # history (<log_file>.h<rank>; rank 0
                                    # keeps the bare path) so `obs pod`
@@ -401,6 +418,27 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "(TensorBoard profile tab); with --profile_trigger/"
                         "--profile_steps, holds their bounded capture "
                         "windows instead")
+    p.add_argument("--metrics_file", type=str, default=None,
+                   help="live OpenMetrics/Prometheus textfile (node-"
+                        "exporter textfile-collector format): counters, "
+                        "epoch rollup, goodput and alert gauges, written "
+                        "atomically at the heartbeat's step-grain throttle "
+                        "(rank 0 the bare path, rank k .h<k> — "
+                        "docs/observability.md)")
+    p.add_argument("--metrics_port", type=int, default=d.metrics_port,
+                   help="serve the same exposition on a rank-0-only "
+                        "background HTTP /metrics endpoint (stdlib, "
+                        "serves the last snapshot — a scrape can never "
+                        "stall a step); 0 disables")
+    p.add_argument("--alert_rules", type=str, default=None,
+                   help="declarative threshold alerting: 'default' (the "
+                        "built-in library: stall/MFU/goodput/grad-norm/"
+                        "heartbeat/retrace rules) or a TOML/JSON spec "
+                        "path (metric, comparator, threshold, sustain-"
+                        "for-N-windows, cooldown). Fired rules emit "
+                        "'alert' history records, rank-0 warnings, and "
+                        "alert_active exporter gauges; rules with "
+                        "profile=true arm the triggered profiler")
     p.add_argument("--per_host_log", action="store_true",
                    help="every process writes its own JSONL history "
                         "(<log_file>.h<rank>; rank 0 keeps the bare path) "

@@ -4,7 +4,7 @@ in ``utils/config.py:8``). One JSON object per line, append-only, rank-0
 only; consumable by pandas/jq/tensorboard-importers and by
 ``python -m tpu_dist.obs summarize`` (docs/observability.md).
 
-Schema (version 3): every record carries
+Schema (version 5): every record carries
 
 * ``ts`` — wall clock (epoch seconds; for humans and cross-run joins),
 * ``rel_s`` — monotonic seconds since this history opened (immune to NTP
@@ -21,11 +21,13 @@ Version history: v2 added ``rel_s``/``run_id``/``counters``; v3 added the
 device-health layer — ``device_stats`` and ``anomaly`` record kinds and
 the ``mfu`` field on ``train_epoch``; v4 added the fleet layer —
 ``goodput`` (per-window wall-clock buckets + a run-end ``final`` totals
-record) and ``profile`` (triggered device-capture events) kinds
+record) and ``profile`` (triggered device-capture events) kinds; v5
+added the live layer — the ``alert`` kind (a declarative threshold rule
+fired: rule/metric/value/threshold/sustained, ``obs/alerts.py``)
 (docs/observability.md). Consumers (``obs summarize``/``compare``) read
 all versions: every addition is a new kind or optional field, never a
 changed one, and readers skip-with-count kinds they don't know — so a
-v3 reader tolerates a v4 log the same way a v4 reader tolerates a v5
+v4 reader tolerates a v5 log the same way a v5 reader tolerates a v6
 one.
 
 The file handle is opened once, line-buffered, and reused — the previous
@@ -44,7 +46,7 @@ import jax
 
 from tpu_dist.obs import counters as counters_lib
 
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 
 
 class MetricsHistory:

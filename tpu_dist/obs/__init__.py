@@ -1,18 +1,20 @@
-"""Run telemetry + device-side training health + the fleet layer: span
-tracing, subsystem counters, heartbeat, straggler detection, in-step
-health scalars (``device_stats``), cost/MFU accounting (``costmodel``),
-anomaly detection, the goodput ledger (``goodput``), triggered device
-profiling (``profile``), pod aggregation (``aggregate``), and the
-offline ``python -m tpu_dist.obs summarize`` / ``compare`` / ``pod``
+"""Run telemetry + device-side training health + the fleet layer + the
+LIVE layer: span tracing, subsystem counters, heartbeat, straggler
+detection, in-step health scalars (``device_stats``), cost/MFU
+accounting (``costmodel``), anomaly detection, the goodput ledger
+(``goodput``), triggered device profiling (``profile``), pod
+aggregation (``aggregate``), OpenMetrics/Prometheus export
+(``export``), declarative threshold alerting (``alerts``), and the
+``python -m tpu_dist.obs summarize`` / ``compare`` / ``pod`` / ``tail``
 CLI.
 
-Contract (audited by TD106/TD107/TD108): the host-telemetry half —
-goodput ledger and profiler trigger control included — is host-side
-only: arming it leaves the traced train step byte-identical and adds no
-per-step device transfers. The one deliberately device-side piece,
-``device_stats`` (opt-in ``--device_metrics``), adds zero collectives and
-rides the existing single per-step metrics fetch. See
-``docs/observability.md``.
+Contract (audited by TD106/TD107/TD108/TD109): the host-telemetry half
+— goodput ledger, profiler trigger control, live exporter, and alert
+engine included — is host-side only: arming it leaves the traced train
+step byte-identical and adds no per-step device transfers. The one
+deliberately device-side piece, ``device_stats`` (opt-in
+``--device_metrics``), adds zero collectives and rides the existing
+single per-step metrics fetch. See ``docs/observability.md``.
 """
 
 from tpu_dist.obs import counters, goodput, spans  # noqa: F401
@@ -40,4 +42,12 @@ def __getattr__(name):
         return TriggeredProfiler
     if name == "GoodputLedger":
         return goodput.GoodputLedger
+    if name == "MetricsExporter":
+        from tpu_dist.obs.export import MetricsExporter
+
+        return MetricsExporter
+    if name == "AlertEngine":
+        from tpu_dist.obs.alerts import AlertEngine
+
+        return AlertEngine
     raise AttributeError(f"module 'tpu_dist.obs' has no attribute {name!r}")

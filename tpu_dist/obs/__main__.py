@@ -4,7 +4,17 @@ Subcommands::
 
     summarize <run.jsonl> [--format text|json]
         Per-epoch throughput, step-time p50/p95/p99, data-stall fraction,
-        counter deltas, straggler findings — from a ``--log_file`` JSONL.
+        counter deltas, straggler/alert findings — from a ``--log_file``
+        JSONL.  With ``--bench`` the input is a bench.py JSON instead:
+        per-record table with capture fingerprints, flagging byte-
+        identical re-emitted captures as STALE.
+
+    tail <run.jsonl> [--heartbeat hb.json] [--interval S] [--once]
+        Follow a LIVE run from another terminal: rolling per-epoch table
+        (throughput / p50 / stall / MFU / goodput) plus live alert,
+        anomaly, straggler, and heartbeat-liveness lines, torn-tail
+        tolerant.  Exits when the run-end record lands; ``--once``
+        renders the current state and returns.
 
     export-trace <run.jsonl> [-o trace.json]
         Chrome trace-event JSON (Perfetto / chrome://tracing loadable)
@@ -49,6 +59,26 @@ def main(argv=None) -> int:
     s = sub.add_parser("summarize", help="per-epoch throughput/latency/counter report")
     s.add_argument("log", help="JSONL history written by --log_file")
     s.add_argument("--format", choices=("text", "json"), default="text")
+    s.add_argument(
+        "--bench", action="store_true",
+        help="input is a bench.py JSON (one record per line): per-record "
+             "report with capture fingerprints; byte-identical re-emitted "
+             "captures are flagged STALE instead of read as fresh",
+    )
+    tl = sub.add_parser(
+        "tail", help="follow a live run: rolling epoch table + alerts"
+    )
+    tl.add_argument("log", help="the run's --log_file JSONL (may still be growing)")
+    tl.add_argument(
+        "--heartbeat", default=None, metavar="FILE",
+        help="the run's --heartbeat_file for a liveness/staleness row",
+    )
+    tl.add_argument("--interval", type=float, default=2.0, metavar="S",
+                    help="poll/redraw interval (default 2s)")
+    tl.add_argument("--once", action="store_true",
+                    help="render the current state once and exit")
+    tl.add_argument("--rows", type=int, default=None, metavar="N",
+                    help="epochs kept in the rolling table")
     t = sub.add_parser("export-trace", help="write Chrome trace-event JSON")
     t.add_argument("log", help="JSONL history written by --log_file")
     t.add_argument("-o", "--out", default=None, help="output path (default: <log>.trace.json)")
@@ -91,6 +121,34 @@ def main(argv=None) -> int:
     )
     pd.add_argument("--format", choices=("text", "json"), default="text")
     args = ap.parse_args(argv)
+
+    if args.cmd == "tail":
+        from tpu_dist.obs import tail as tail_lib
+
+        return tail_lib.run_tail(
+            args.log,
+            heartbeat=args.heartbeat,
+            interval=args.interval,
+            once=args.once,
+            **({"rows": args.rows} if args.rows else {}),
+        )
+
+    if args.cmd == "summarize" and args.bench:
+        from tpu_dist.obs import compare as compare_lib
+
+        try:
+            report = compare_lib.bench_report(args.log)
+        except OSError as e:
+            print(f"tpu_dist.obs: cannot read {args.log}: {e}", file=sys.stderr)
+            return 2
+        except ValueError as e:
+            print(f"tpu_dist.obs: {e}", file=sys.stderr)
+            return 1
+        if args.format == "json":
+            print(json.dumps(report, indent=2))
+        else:
+            print(compare_lib.format_bench_report(report))
+        return 0
 
     if args.cmd == "pod":
         from tpu_dist.obs import aggregate as aggregate_lib

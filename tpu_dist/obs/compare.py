@@ -137,9 +137,22 @@ def _result(rows: List[dict], threshold: float) -> dict:
         "threshold": threshold,
         "rows": rows,
         "regressions": sum(r["verdict"] == "REGRESSED" for r in rows),
-        "compared": sum(r["verdict"] != "skipped" for r in rows),
+        "compared": sum(r["verdict"] not in ("skipped", "STALE") for r in rows),
         "skipped": sum(r["verdict"] == "skipped" for r in rows),
+        "stale": sum(r["verdict"] == "STALE" for r in rows),
     }
+
+
+def capture_fingerprint(rec: dict) -> Optional[tuple]:
+    """The bench record's capture identity (``bench.py`` stamps hostname,
+    a per-invocation id, and a monotonic capture time into every record).
+    Two records with the SAME fingerprint are one physical capture — a
+    candidate re-emitting the baseline's fingerprint is a stale copy,
+    not a fresh measurement. None on pre-stamp (legacy) records."""
+    cap = rec.get("capture")
+    if isinstance(cap, dict) and cap.get("bench_run_id"):
+        return (cap.get("host"), cap.get("bench_run_id"), cap.get("mono_s"))
+    return None
 
 
 # -- input loading -----------------------------------------------------------
@@ -163,21 +176,7 @@ def load_bench_records(path: str) -> dict:
     """bench.py output (JSON object per line) → ``{metric_name: record}``.
     Tolerates a torn tail like the history loader; raises ValueError when
     nothing parses."""
-    out = {}
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if isinstance(rec, dict) and rec.get("metric"):
-                out[rec["metric"]] = rec
-    if not out:
-        raise ValueError(f"no bench records in {path}")
-    return out
+    return {rec["metric"]: rec for rec in _load_bench_list(path)}
 
 
 def compare_bench(base: dict, cand: dict, threshold: float = 0.05) -> dict:
@@ -192,6 +191,25 @@ def compare_bench(base: dict, cand: dict, threshold: float = 0.05) -> dict:
                 "baseline": None if b is None else "present",
                 "candidate": None if c is None else "present",
                 "verdict": "skipped",
+            })
+            continue
+        fp_b, fp_c = capture_fingerprint(b), capture_fingerprint(c)
+        if (fp_b is not None and fp_b == fp_c) or b.get("stale") or c.get("stale"):
+            # the candidate is a byte-identical re-emission of the
+            # baseline's capture (the r03–r05 staleness failure mode), or
+            # either side carries bench's own stale:true last-good-
+            # fallback stamp: comparing those numbers would read as "no
+            # regression" when nothing was measured — flag, don't compare
+            rows.append({
+                "metric": name,
+                "baseline": (
+                    "stale capture" if b.get("stale")
+                    else "capture " + str((fp_b or ("?",) * 2)[1])
+                ),
+                "candidate": (
+                    "stale capture" if c.get("stale") else "same capture"
+                ),
+                "verdict": "STALE",
             })
             continue
         for field, direction, slack in BENCH_FIELDS:
@@ -263,5 +281,103 @@ def format_text(result: dict) -> str:
         f"compare: {result['regressions']} regression(s) over "
         f"{result['compared']} compared metric(s)"
         + (f", {result['skipped']} skipped" if result["skipped"] else "")
+        + (
+            f", {result['stale']} STALE (candidate re-emits the "
+            "baseline's capture — not a fresh measurement)"
+            if result.get("stale") else ""
+        )
     )
+    return "\n".join(lines)
+
+
+# -- bench staleness report (`obs summarize --bench`) ------------------------
+
+
+def _load_bench_list(path: str) -> List[dict]:
+    """Order-preserving bench loader that keeps duplicates — the
+    staleness report must SEE re-emitted records, which the by-metric
+    dict of :func:`load_bench_records` (built on this) collapses."""
+    out: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and rec.get("metric"):
+                out.append(rec)
+    if not out:
+        raise ValueError(f"no bench records in {path}")
+    return out
+
+
+def bench_report(path: str) -> dict:
+    """Per-record bench summary with capture-staleness flags: a record is
+    ``stale`` when it carries the self-declared ``stale: true`` stamp
+    (bench's last-good fallback) or repeats an earlier record's capture
+    fingerprint byte-for-byte (a re-emission inside one artifact)."""
+    seen: dict = {}
+    rows: List[dict] = []
+    for rec in _load_bench_list(path):
+        fp = capture_fingerprint(rec)
+        reemitted = fp is not None and fp in seen
+        row = {
+            "metric": rec.get("metric"),
+            "value": rec.get("value"),
+            "unit": rec.get("unit"),
+            "mfu": rec.get("mfu"),
+            "stale": bool(rec.get("stale")) or reemitted,
+        }
+        if fp is not None:
+            row["capture"] = {
+                "host": fp[0], "bench_run_id": fp[1], "mono_s": fp[2],
+            }
+            if reemitted:
+                row["stale_of"] = seen[fp]
+            else:
+                seen[fp] = rec.get("metric")
+        if rec.get("age_days") is not None:
+            row["age_days"] = rec["age_days"]
+        rows.append(row)
+    return {
+        "path": path,
+        "records": rows,
+        "n_stale": sum(r["stale"] for r in rows),
+        "n_unfingerprinted": sum("capture" not in r for r in rows),
+    }
+
+
+def format_bench_report(report: dict) -> str:
+    lines = [
+        f"bench {report['path']}: {len(report['records'])} record(s)"
+        + (f", {report['n_stale']} STALE" if report["n_stale"] else "")
+        + (
+            f", {report['n_unfingerprinted']} without capture fingerprint "
+            "(pre-stamp)"
+            if report["n_unfingerprinted"] else ""
+        )
+    ]
+    w = max([len(str(r["metric"])) for r in report["records"]] + [6])
+    for r in report["records"]:
+        cap = r.get("capture") or {}
+        lines.append(
+            f"  {str(r['metric']).ljust(w)} "
+            f"{str(r.get('value')).rjust(10)} {str(r.get('unit') or ''):<11}"
+            + (
+                f" capture {cap.get('bench_run_id')}@{cap.get('host')}"
+                if cap else " (no fingerprint)"
+            )
+            + (
+                "  STALE"
+                + (f" (re-emits {r['stale_of']})" if r.get("stale_of") else "")
+                + (
+                    f" ({r['age_days']}d old)"
+                    if r.get("age_days") is not None else ""
+                )
+                if r["stale"] else ""
+            )
+        )
     return "\n".join(lines)

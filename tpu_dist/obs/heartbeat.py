@@ -40,7 +40,8 @@ class Heartbeat:
         self.path = path
         self.min_interval = min_interval
         self.counter = 0
-        self._last_write = float("-inf")
+        self._last_write = float("-inf")  # last ATTEMPT (drives the throttle)
+        self._last_ok = float("-inf")     # last write that LANDED (drives age)
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
 
@@ -82,7 +83,17 @@ class Heartbeat:
         except OSError:
             counters.inc("heartbeat.write_errors")
             return False
+        self._last_ok = now
         return True
+
+    def age(self) -> float:
+        """Seconds since this writer's last beat LANDED on disk
+        (monotonic) — the exporter publishes it as ``heartbeat.age_s``.
+        Failed writes (full disk) do not reset it: the age must track the
+        file an external watchdog reads, not our attempts. ``inf``
+        before the first successful write."""
+        last = self._last_ok
+        return float("inf") if last == float("-inf") else time.monotonic() - last
 
     def sweep(self) -> None:
         """Remove the file — clean-exit signal. Best-effort by design."""
@@ -91,6 +102,7 @@ class Heartbeat:
                 os.remove(p)
             except FileNotFoundError:
                 pass
+        _LAST_GOOD.pop(self.path, None)
 
 
 def per_rank_path(base: str, rank: int) -> str:
@@ -101,10 +113,33 @@ def per_rank_path(base: str, rank: int) -> str:
     return base if rank == 0 else f"{base}.h{rank}"
 
 
+# last successfully parsed beat per path: the torn-read fallback below.
+# Process-local by design — each watchdog process keeps its own view.
+_LAST_GOOD: dict = {}
+
+
 def read(path: str) -> Optional[dict]:
-    """Watchdog-side read; None when absent (clean exit or not started)."""
+    """Watchdog-side read; None when absent (clean exit or not started).
+
+    Torn-read hardening: ``os.replace`` is atomic on POSIX local
+    filesystems, but on NFS (and some overlay mounts) a reader racing
+    the replace can observe a truncated/partial file. A beat that fails
+    to parse is NOT a dead worker — so instead of reporting None (which
+    a watchdog reads as "exited"), return the PREVIOUS good parse for
+    this path and count it (``heartbeat.torn_reads``). A genuinely
+    absent file still returns None and forgets the cache: absence is the
+    clean-exit signal and must not be masked by a stale beat."""
     try:
         with open(path) as f:
-            return json.load(f)
-    except (FileNotFoundError, json.JSONDecodeError):
+            rec = json.load(f)
+    except FileNotFoundError:
+        _LAST_GOOD.pop(path, None)
         return None
+    except (json.JSONDecodeError, OSError):
+        counters.inc("heartbeat.torn_reads")
+        return _LAST_GOOD.get(path)
+    if isinstance(rec, dict):
+        _LAST_GOOD[path] = rec
+        return rec
+    counters.inc("heartbeat.torn_reads")
+    return _LAST_GOOD.get(path)

@@ -8,8 +8,9 @@ schema (``docs/observability.md``): one object per line, ``kind`` keyed —
 ``train_epoch`` (throughput, step-time percentiles, stall fraction, MFU,
 a counter-registry snapshot), ``eval``, ``straggler``, ``device_stats``
 (the per-step ``--device_metrics`` scalars, aggregated per epoch here),
-``anomaly`` (loss-spike / grad-explosion findings), ``spans`` (drained
-Chrome trace events), ``auto_recover``. A torn trailing line (the process
+``anomaly`` (loss-spike / grad-explosion findings), ``alert`` (a
+declarative threshold rule fired — ``obs/alerts.py``), ``spans``
+(drained Chrome trace events), ``auto_recover``. A torn trailing line (the process
 died mid-write) is tolerated and reported, not fatal. The regression-gate
 half of the CLI (``compare``) lives in ``obs/compare.py`` and consumes
 :func:`summarize`'s report.
@@ -28,13 +29,13 @@ from tpu_dist.obs import goodput as goodput_lib
 #: kinds summarized; their unknown kinds are skipped with a count — the
 #: forward-compat contract that lets v3 tooling read v4 logs and vice
 #: versa (every schema bump is additive).
-SUPPORTED_SCHEMA = 4
+SUPPORTED_SCHEMA = 5
 
 #: Record kinds this reader folds into the report. Anything else is
 #: counted into ``skipped_kinds`` — never an error, never silent.
 KNOWN_KINDS = frozenset((
     "train_epoch", "eval", "straggler", "anomaly", "device_stats",
-    "auto_recover", "spans", "goodput", "profile",
+    "auto_recover", "spans", "goodput", "profile", "alert",
 ))
 
 
@@ -67,6 +68,7 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
     evals = {}
     stragglers = []
     anomalies: List[dict] = []
+    alerts: List[dict] = []
     profiles: List[dict] = []
     goodput_epochs: List[dict] = []
     dstats: dict = {}  # epoch -> per-epoch device_stats aggregate
@@ -102,6 +104,13 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
             stragglers.append(
                 {k: rec.get(k) for k in ("epoch", "skew", "worst_rank", "max_s", "median_s")}
             )
+        elif kind == "alert":
+            alerts.append({
+                k: rec.get(k)
+                for k in ("epoch", "step", "rule", "metric", "value",
+                          "threshold", "op", "sustained")
+                if rec.get(k) is not None
+            })
         elif kind == "anomaly":
             anomalies.append({
                 k: rec.get(k)
@@ -199,6 +208,7 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
         "partial_epoch_device_stats": partial,
         "stragglers": stragglers,
         "anomalies": anomalies,
+        "alerts": alerts,
         "profiles": profiles,
         "goodput_epochs": goodput_epochs,
         # run-level goodput ledger: resumed segments folded, restart gaps
@@ -287,6 +297,14 @@ def format_text(report: dict) -> str:
             "update_ratio "
             f"{_fmt(ds.get('update_ratio_last'), '.3g', 0).strip()} "
             f"({ds.get('samples')} sample(s))"
+        )
+    for a in report.get("alerts", []):
+        lines.append(
+            f"alert: {a.get('rule')} fired at epoch {a.get('epoch')}"
+            + (f" step {a.get('step')}" if a.get("step") is not None else "")
+            + f" — {a.get('metric')} {a.get('value')} {a.get('op')} "
+            f"threshold {a.get('threshold')} "
+            f"(sustained {a.get('sustained')} window(s))"
         )
     for a in report.get("anomalies", []):
         lines.append(

@@ -231,6 +231,20 @@ class Trainer:
                 max_captures=cfg.profile_max_captures,
                 manual_range=manual_profile,
             )
+        # live telemetry (obs/export.py, obs/alerts.py): both specs are
+        # validated HERE too — a bad rule file or port fails before any
+        # model/data work, same posture as the profiler specs above
+        if cfg.metrics_port < 0 or cfg.metrics_port > 65535:
+            raise ValueError(
+                f"metrics_port must be 0 (off) or a valid TCP port, got "
+                f"{cfg.metrics_port}"
+            )
+        self._alert_rule_list = None
+        if cfg.alert_rules:
+            from tpu_dist.obs import alerts as alerts_lib  # noqa: PLC0415
+
+            # raises on a malformed spec / unknown builtin / dup names
+            self._alert_rule_list = alerts_lib.load_rules(cfg.alert_rules)
         if cfg.pp_interleave < 1:
             raise ValueError(f"pp_interleave must be >= 1, got {cfg.pp_interleave}")
         if cfg.pp_interleave > 1 and cfg.pp <= 1:
@@ -848,6 +862,10 @@ class Trainer:
 
         self._async_ckpt = None  # created lazily by _ckpt_io()
         self._heartbeat = None  # created by fit() (rank 0, --heartbeat_file)
+        self._exporter = None  # live OpenMetrics publisher, created by fit()
+        self._alerts = None  # AlertEngine, created by fit() per run
+        self._export_rollup = {}  # latest epoch/health scalars for export
+        self._export_t = float("-inf")  # exposition throttle mark
         self._trace_events = []  # drained spans held for --trace_file export
         self._step_traced = False  # first dispatch of THIS Trainer compiles
         self._history = None  # live MetricsHistory while fit() runs — the
@@ -1276,6 +1294,12 @@ class Trainer:
             timer.tick()
             if hb is not None:
                 hb.beat(epoch=epoch, step=step)
+            if self._exporter is not None:
+                # live exposition at the SAME step-grain throttle as the
+                # heartbeat: inside the window only the in-memory HTTP
+                # snapshot is (not even) refreshed — the throttle check is
+                # the whole per-step cost
+                self._export_live()
             if faults.active() is not None:  # zero-cost when no --fault_plan
                 self._apply_step_faults(epoch, step, lr)
             want_save = (
@@ -1567,6 +1591,17 @@ class Trainer:
             for k in ("grad_norm", "update_ratio"):
                 if k in m:
                     self._tb.add_scalar(f"step/{k}", m[k], gs)
+        # live layer at the fetch cadence: the health norms land in the
+        # next exposition, and the step-grain alert rules (grad-norm
+        # ceiling) see the SAME host copy — zero additional device traffic
+        if self._exporter is not None:
+            for k in ("grad_norm", "param_norm", "update_ratio"):
+                if k in m:
+                    self._export_rollup[f"device.{k}"] = m[k]
+        if self._alerts is not None:
+            fired = self._alerts.observe(m)
+            if fired:
+                self._fire_alerts(fired, epoch, step)
         if self._anomaly is None:
             return
         findings = self._anomaly.observe(
@@ -1640,6 +1675,93 @@ class Trainer:
                     + ") — pre-divergence state preserved off the resume "
                     "namespace"
                 )
+
+    def _export_live(self, force: bool = False) -> None:
+        """Publish one OpenMetrics exposition (``obs/export.py``): the
+        counter registry, the latest epoch rollup + health norms, the
+        goodput totals so far, the heartbeat age, and the per-rule
+        ``alert_active`` gauges. Throttled HERE (not just in the writer)
+        so the per-step cost inside the window is one clock read — the
+        render/snapshot work only happens when something will publish."""
+        if self._exporter is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._export_t < self._exporter.min_interval:
+            return
+        self._export_t = now
+        values = dict(counters_lib.snapshot())
+        values.update(self._export_rollup)
+        # run-level goodput totals over the closed windows so far — the
+        # same numbers the ledger's final record will carry
+        totals = self._goodput.run_totals()
+        for b in goodput_lib.ALL_BUCKETS:
+            values[f"goodput.{b}_s"] = totals[f"{b}_s"]
+        values["goodput.goodput_frac"] = totals["goodput_frac"]
+        if self._heartbeat is not None:
+            age = self._heartbeat.age()
+            if age != float("inf"):
+                values["heartbeat.age_s"] = round(age, 3)
+        labeled = (
+            {"alert_active": self._alerts.active()}
+            if self._alerts is not None else None
+        )
+        self._exporter.update(values, labeled, force=True)
+
+    def _epoch_live_update(self, epoch: int, last: dict) -> None:
+        """Close of an epoch for the live layer: refresh the exporter's
+        rollup (throughput, percentiles, stall, MFU, eval top-1), run the
+        epoch-grain alert rules over the rollup + goodput fraction +
+        counter snapshot (the delta rules — mid-run retraces — read the
+        monotonic counters), and force an exposition so a scraper sees
+        the epoch boundary immediately."""
+        rollup = self._export_rollup
+        rollup["train.epoch"] = epoch
+        for key in ("images_per_sec", "loss", "mfu", "data_stall_frac",
+                    "epoch_time"):
+            if isinstance(last.get(key), (int, float)):
+                rollup[f"train.{key}"] = last[key]
+        for key in ("step_time_p50", "step_time_p95", "step_time_p99"):
+            if isinstance(last.get(key), (int, float)):
+                rollup[f"train.{key}_s"] = last[key]
+        if isinstance(last.get("val_top1"), (int, float)):
+            rollup["eval.top1"] = last["val_top1"]
+        if self._alerts is not None:
+            window = {
+                k: v for k, v in last.items() if isinstance(v, (int, float))
+            }
+            window["goodput_frac"] = self._goodput.run_totals()["goodput_frac"]
+            window.update(counters_lib.snapshot())
+            fired = self._alerts.observe(window)
+            if fired:
+                self._fire_alerts(fired, epoch, None)
+        self._export_live(force=True)
+
+    def _fire_alerts(self, fired: list, epoch: int, step) -> None:
+        """A rule fired: rank-0 warning + ``alert`` history record
+        (schema v5) + counter + exporter gauge flip (the next exposition
+        carries ``alert_active{rule=...} 1``) + — for ``profile = true``
+        rules — an armed triggered-profiler capture, so the steps that
+        explain the breach land on an XLA timeline."""
+        for a in fired:
+            counters_lib.inc("alerts.fired")
+            rank0_print(
+                f"WARNING: ALERT {a['rule']}: {a['metric']} = {a['value']} "
+                f"{a['op']} threshold {a['threshold']} (sustained "
+                f"{a['sustained']} window(s))"
+            )
+            if self._history is not None:
+                extra = {"epoch": epoch}
+                if step is not None:
+                    extra["step"] = step
+                self._history.log("alert", **extra, **a)
+            if (
+                a.get("profile")
+                and self._profiler is not None
+                and mesh_lib.is_primary()
+            ):
+                self._profiler.arm(f"alert_{a['rule']}")
+        if self._exporter is not None:
+            self._export_live(force=True)
 
     def _note_profile_event(self, ev: dict, epoch: int, step) -> None:
         """A triggered-profiler window opened/closed/failed: rank-0 line +
@@ -1944,6 +2066,48 @@ class Trainer:
             self._heartbeat.beat(
                 epoch=self.start_epoch, phase="start", force=True
             )
+        # live export + alerting (docs/observability.md "Live export"):
+        # the exporter publishes the counter registry + the latest epoch
+        # rollup as OpenMetrics (textfile at the heartbeat's step-grain
+        # throttle, rank-0 HTTP endpoint serving the last snapshot); the
+        # alert engine evaluates the declarative rules at the epoch grain
+        # (stall/MFU/goodput/retraces) and the step-fetch grain (norms).
+        # Host-side only — TD109 pins the traced step byte-identical.
+        self._exporter = None
+        self._alerts = None
+        self._export_rollup = {}
+        self._export_t = float("-inf")
+        if cfg.metrics_file or cfg.metrics_port:
+            from tpu_dist.obs.export import MetricsExporter  # noqa: PLC0415
+            from tpu_dist.obs.heartbeat import per_rank_path  # noqa: PLC0415
+
+            rank = jax.process_index()
+            textfile = (
+                per_rank_path(cfg.metrics_file, rank)
+                if cfg.metrics_file else None
+            )
+            # the HTTP endpoint is rank-0-only (MetricsExporter refuses a
+            # port on rank >= 1); other ranks export via their derived
+            # textfile only — and with --metrics_port alone, rank >= 1 has
+            # NO output surface, so it skips the exporter entirely rather
+            # than render expositions nothing can read
+            port = (cfg.metrics_port or None) if rank == 0 else None
+            if textfile or port:
+                self._exporter = MetricsExporter(
+                    textfile=textfile, port=port, rank=rank
+                )
+        if self._alert_rule_list:
+            from tpu_dist.obs.alerts import AlertEngine  # noqa: PLC0415
+
+            # fresh streak/cooldown state per fit(); runs on EVERY process
+            # (like the anomaly detector) — its actions are rank-scoped
+            # (rank-0 history/warning, per-process exporter gauges), never
+            # collective, so per-host metric divergence is harmless
+            self._alerts = AlertEngine(self._alert_rule_list)
+            # delta rules (mid-run retraces) fire on change SINCE FIT
+            # START — a counter born mid-run must alert on its first
+            # increment, not spend it establishing a baseline
+            self._alerts.seed_deltas(counters_lib.snapshot())
         last = {}
         self._last_epoch = self.start_epoch
         self._in_epoch = False
@@ -2021,6 +2185,17 @@ class Trainer:
             if self._tb is not None:
                 self._tb.close()
             self._close_goodput(history)
+            if self._exporter is not None:
+                # one final forced exposition — the closing totals stay
+                # scrapeable in the textfile (deliberately not deleted:
+                # the last exposition documents how the run ended) — then
+                # stop the HTTP thread
+                try:
+                    self._export_live(force=True)
+                finally:
+                    self._exporter.close()
+                    self._exporter = None
+            self._alerts = None
             if telemetry:
                 self._export_telemetry(history)
             self._history = None
@@ -2330,10 +2505,15 @@ class Trainer:
             # close this epoch's goodput window (train + eval + save):
             # one v4 record per epoch; the records chain, partitioning the
             # run's wall-clock exactly (obs/goodput.py)
-            if history.path:
-                history.log(
-                    "goodput", epoch=epoch, **self._goodput.window_record()
-                )
+            live = self._exporter is not None or self._alerts is not None
+            if history.path or live:
+                # the live layer needs the window CLOSED too (run totals
+                # feed the goodput gauges and the goodput-floor rule)
+                gp_rec = self._goodput.window_record()
+                if history.path:
+                    history.log("goodput", epoch=epoch, **gp_rec)
+            if live:
+                self._epoch_live_update(epoch, last)
             if preemption.requested():
                 # SIGTERM during eval/save lands here: the epoch is complete
                 # and published — the emergency path keeps/writes ckpt_epoch
