@@ -1,6 +1,6 @@
 # Convenience targets; CI / the driver call the underlying commands directly.
 
-.PHONY: test quick bench csrc clean lint pod-report monitor
+.PHONY: test quick bench csrc clean lint pod-report monitor profile-report
 
 csrc:
 	$(MAKE) -C tpu_dist/csrc
@@ -26,6 +26,13 @@ bench:
 # and optionally one merged Perfetto timeline)
 pod-report:
 	python -m tpu_dist.obs pod $(LOGS) $(if $(TRACE),--trace-out $(TRACE))
+
+# Device-time attribution of a jax.profiler capture:
+#   make profile-report CAPTURE=prof_dir/capture_0_s12_anomaly [TOP=10]
+# (docs/observability.md "Trace analytics" — per-category device seconds,
+# collectives by kind, comm/compute overlap, top ops)
+profile-report:
+	python -m tpu_dist.obs xprof $(CAPTURE) $(if $(TOP),--top $(TOP))
 
 # Follow a LIVE run from another terminal:
 #   make monitor LOG=run.jsonl [HB=hb.json]

@@ -410,10 +410,11 @@ def test_compare_missing_metrics_reported_skipped_not_dropped(tmp_path):
 
     base = _write_jsonl(tmp_path / "b.jsonl", [_epoch_rec(0, 1000.0, 2.0)])
     cand = _write_jsonl(tmp_path / "c.jsonl", [_epoch_rec(0, 1000.0, 2.0)])
-    result = cmp.compare_files(base, cand)  # no mfu/eval/goodput either side
+    result = cmp.compare_files(base, cand)  # no mfu/eval/goodput/capture
     skipped = {r["metric"] for r in result["rows"] if r["verdict"] == "skipped"}
-    assert skipped == {"mfu_mean", "final_val_top1", "goodput_frac"}
-    assert result["skipped"] == 3
+    assert skipped == {"mfu_mean", "final_val_top1", "goodput_frac",
+                       "overlap_frac", "collective_frac"}
+    assert result["skipped"] == 5
 
 
 def test_compare_bench_mode_matches_by_metric_name(tmp_path):

@@ -6,7 +6,7 @@ import pytest
 
 from tpu_dist.comm import mesh as mesh_lib
 from tpu_dist.metrics.consistency import check_replicated
-from tpu_dist.metrics.profiler import StepTimer, annotate_step, trace
+from tpu_dist.obs.profile import StepTimer, annotate_step, trace
 
 
 def test_step_timer_skips_warmup():
@@ -64,7 +64,7 @@ def test_check_replicated_detects_divergence():
 @pytest.mark.slow  # >10s e2e: excluded from the timed tier-1 gate; the
 # quick slice keeps a fast representative of this subsystem in the gate
 def test_trainer_profile_dir_captures_trace(tmp_path):
-    """--profile_dir wraps epoch 0 in the XLA profiler (metrics/profiler.py):
+    """--profile_dir wraps epoch 0 in the XLA profiler (obs/profile.py):
     a TensorBoard-readable xplane capture must land on disk."""
     from tests.helpers import tiny_resnet
     from tpu_dist.config import TrainConfig

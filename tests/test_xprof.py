@@ -1,0 +1,678 @@
+"""Profile analytics (ISSUE 9): the ``obs/xprof.py`` capture analyzer —
+category attribution summing to device busy time, comm/compute overlap,
+malformed-capture hardening, the auto-analyze hook, cost-model
+calibration, the TD110 noop gate, and the summarize/compare/tail/pod/CLI
+surfaces of ``profile_analysis`` records (schema v6)."""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from tpu_dist.obs import counters, spans, xprof
+from tpu_dist.obs import profile as profile_lib
+from tpu_dist.obs.summarize import format_text, summarize
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    spans.disable()
+    spans.drain()
+    counters.reset()
+    yield
+    spans.disable()
+    spans.drain()
+    counters.reset()
+
+
+# -- synthetic trace builders ------------------------------------------------
+
+
+def _meta(pid=1, pname="/device:TPU:0", threads=((10, "XLA Ops"),)):
+    evs = [{"ph": "M", "pid": pid, "name": "process_name",
+            "args": {"name": pname}}]
+    for tid, tname in threads:
+        evs.append({"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+                    "args": {"name": tname}})
+    return evs
+
+
+def _x(name, ts, dur, pid=1, tid=10, args=None):
+    e = {"ph": "X", "pid": pid, "tid": tid, "name": name, "ts": ts, "dur": dur}
+    if args is not None:
+        e["args"] = args
+    return e
+
+
+def _write_capture(root, events, host="host0", run="run1"):
+    """Lay events out exactly as jax.profiler does:
+    ``<root>/plugins/profile/<run>/<host>.trace.json.gz``."""
+    d = os.path.join(str(root), "plugins", "profile", run)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"{host}.trace.json.gz")
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
+
+
+# -- classification ----------------------------------------------------------
+
+
+def test_classify_categories():
+    assert xprof.classify("dot.6") == "matmul_conv"
+    assert xprof.classify("convolution.12") == "matmul_conv"
+    assert xprof.classify("triton_gemm_fusion.3") == "matmul_conv"
+    assert xprof.classify("all-reduce.12") == "collective"
+    assert xprof.classify("all-gather-start.2") == "collective"
+    assert xprof.classify("infeed.1") == "infeed_outfeed"
+    assert xprof.classify("outfeed.4") == "infeed_outfeed"
+    assert xprof.classify("conv.2") == "matmul_conv"
+    # near-miss names that must NOT read as collectives/matmuls —
+    # 'convert' (the ubiquitous dtype cast) above all
+    assert xprof.classify("convert.5") == "fusion_other"
+    assert xprof.classify("reduce-window") == "fusion_other"
+    assert xprof.classify("reduce.16") == "fusion_other"
+    assert xprof.classify("reduce_bitcast_fusion") == "fusion_other"
+    assert xprof.classify("fusion.3") == "fusion_other"
+    assert xprof.classify("tanh.11.clone") == "fusion_other"
+    # runtime bookkeeping (uppercase/space/colon) → host
+    assert xprof.classify("TfrtCpuExecutable::Execute") == "host"
+    assert xprof.classify("D2D Dispatch") == "host"
+    assert xprof.classify("$profiler.py:91 start_trace") == "host"
+
+
+def test_collective_kind_folds_async_halves():
+    assert xprof.collective_kind("all-reduce.3") == "all-reduce"
+    assert xprof.collective_kind("all-gather-start.2") == "all-gather"
+    assert xprof.collective_kind("all-gather-done.2") == "all-gather"
+    assert xprof.collective_kind("reduce-scatter.9") == "reduce-scatter"
+    assert xprof.collective_kind("collective-permute-start.1") == "collective-permute"
+    assert xprof.collective_kind("recv-done.2") == "recv"
+    assert xprof.collective_kind("reduce.1") is None
+    assert xprof.collective_kind("dot.6") is None
+
+
+# -- self-time / interval math ----------------------------------------------
+
+
+def test_self_time_subtracts_nested_children():
+    # parent [0,100] wraps child [10,30]: self 80 + 20, sum == union 100
+    evs = [(0.0, 100.0, 0), (10.0, 20.0, 1)]
+    selfs = xprof._self_times_us(evs)
+    assert selfs[0] == pytest.approx(80.0)
+    assert selfs[1] == pytest.approx(20.0)
+    assert sum(selfs.values()) == pytest.approx(100.0)
+
+
+def test_self_time_clips_jitter_overhang():
+    # "child" [90,120] overhangs parent [0,100] (clock jitter): clipped,
+    # so the thread's self times still sum to the parent union
+    evs = [(0.0, 100.0, 0), (90.0, 30.0, 1)]
+    selfs = xprof._self_times_us(evs)
+    assert sum(selfs.values()) == pytest.approx(100.0)
+
+
+def test_interval_union_and_intersection():
+    assert xprof._union_len([(0, 10), (5, 20), (30, 40)]) == 30
+    assert xprof._intersect_len([(0, 10)], [(5, 25)]) == 5
+    assert xprof._intersect_len([(0, 10)], [(20, 25)]) == 0
+
+
+# -- synthetic capture analysis ----------------------------------------------
+
+
+def test_categories_sum_to_busy_and_known_values(tmp_path):
+    evs = _meta() + [
+        _x("dot.1", 0, 50),          # matmul 50
+        _x("fusion.2", 50, 30),      # fusion 30
+        _x("all-reduce.3", 80, 20),  # collective 20
+        _x("infeed.4", 100, 10),     # infeed 10
+        _x("SparseCoreV0::Step", 110, 5),  # runtime → host 5
+    ]
+    _write_capture(tmp_path, evs)
+    r = xprof.analyze_capture(str(tmp_path))
+    us = 1e-6
+    assert r["categories"]["matmul_conv"] == pytest.approx(50 * us)
+    assert r["categories"]["fusion_other"] == pytest.approx(30 * us)
+    assert r["categories"]["collective"] == pytest.approx(20 * us)
+    assert r["categories"]["infeed_outfeed"] == pytest.approx(10 * us)
+    assert r["categories"]["host"] == pytest.approx(5 * us)
+    assert sum(r["categories"].values()) == pytest.approx(
+        r["device_busy_s"], abs=1e-12
+    )
+    assert r["infeed_stall_s"] == pytest.approx(10 * us)
+    assert r["collectives"] == {"all-reduce": pytest.approx(20 * us)}
+    assert r["collective_frac"] == pytest.approx(20 / 115, abs=1e-3)
+    assert r["analyzed"] == r["n_traces"] == 1
+
+
+def test_overlap_fraction_on_overlapped_workload(tmp_path):
+    # comm [0,100] on thread 10 vs compute [50,250] on thread 11: half the
+    # collective hides under compute → overlap 0.5
+    evs = _meta(threads=((10, "XLA Ops"), (11, "XLA Ops #2"))) + [
+        _x("all-reduce.1", 0, 100, tid=10),
+        _x("dot.2", 50, 200, tid=11),
+    ]
+    _write_capture(tmp_path, evs)
+    r = xprof.analyze_capture(str(tmp_path))
+    ov = r["overlap"]
+    assert ov["comm_s"] == pytest.approx(100e-6)
+    assert ov["compute_s"] == pytest.approx(200e-6)
+    assert ov["overlapped_s"] == pytest.approx(50e-6)
+    assert ov["overlap_frac"] == pytest.approx(0.5)
+
+
+def test_overlap_zero_when_serialized_and_none_without_comm(tmp_path):
+    evs = _meta() + [
+        _x("all-reduce.1", 0, 100),
+        _x("dot.2", 100, 100),       # back-to-back, same thread: no overlap
+    ]
+    _write_capture(tmp_path, evs)
+    r = xprof.analyze_capture(str(tmp_path))
+    assert r["overlap"]["overlap_frac"] == 0.0
+    d2 = tmp_path / "nocomm"
+    _write_capture(d2, _meta() + [_x("dot.1", 0, 100)])
+    r2 = xprof.analyze_capture(str(d2))
+    assert r2["overlap"]["overlap_frac"] is None
+    assert r2["collective_frac"] == 0.0
+
+
+def test_top_ops_ranked_by_self_time_excluding_runtime(tmp_path):
+    evs = _meta() + [
+        _x("dot.1", 0, 60),
+        _x("dot.1", 100, 60),
+        _x("tanh.2", 200, 50),
+        _x("ThreadpoolListener::Record", 300, 500),  # host: not a top op
+    ]
+    _write_capture(tmp_path, evs)
+    r = xprof.analyze_capture(str(tmp_path), top_k=2)
+    assert [o["name"] for o in r["top_ops"]] == ["dot.1", "tanh.2"]
+    assert r["top_ops"][0]["count"] == 2
+    assert r["top_ops"][0]["self_s"] == pytest.approx(120e-6)
+
+
+def test_multi_trace_capture_merges_hosts(tmp_path):
+    _write_capture(tmp_path, _meta() + [_x("dot.1", 0, 100)], host="h0")
+    _write_capture(
+        tmp_path, _meta() + [_x("all-reduce.2", 0, 50)], host="h1"
+    )
+    r = xprof.analyze_capture(str(tmp_path))
+    assert r["n_traces"] == 2 and r["analyzed"] == 2
+    assert r["device_busy_s"] == pytest.approx(150e-6)
+    assert r["categories"]["matmul_conv"] == pytest.approx(100e-6)
+    assert r["categories"]["collective"] == pytest.approx(50e-6)
+
+
+def test_cpu_host_fallback_selects_by_hlo_content(tmp_path):
+    # no /device: process — /host:CPU with hlo_op-stamped events scattered
+    # across pools, runtime noise unstamped (the jax CPU backend layout)
+    evs = _meta(pname="/host:CPU", threads=(
+        (10, "tf_XLAEigen/1"), (11, "tf_XLATfrtCpuClient/2"), (12, "python"),
+    )) + [
+        _x("dot.1", 0, 100, tid=11, args={"hlo_op": "dot.1"}),
+        _x("tanh.2", 0, 40, tid=10, args={"hlo_module": "jit_f"}),
+        _x("PjitFunction(f)", 0, 5000, tid=12),              # runtime: out
+        _x("TfrtCpuExecutable::Execute", 0, 400, tid=11),    # runtime: out
+    ]
+    _write_capture(tmp_path, evs)
+    r = xprof.analyze_capture(str(tmp_path))
+    assert r["device_busy_s"] == pytest.approx(140e-6)
+    assert r["categories"]["host"] == 0.0
+
+
+# -- malformed captures: typed errors, partial reports, counted drops --------
+
+
+def test_empty_capture_dir_typed_error(tmp_path):
+    with pytest.raises(xprof.EmptyCaptureError):
+        xprof.analyze_capture(str(tmp_path))
+    with pytest.raises(xprof.EmptyCaptureError):
+        xprof.analyze_capture(str(tmp_path / "never_made"))
+
+
+def test_truncated_gzip_typed_error(tmp_path):
+    path = _write_capture(tmp_path, _meta() + [_x("dot.1", 0, 10)])
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[: len(blob) // 2])  # cut the gzip stream mid-member
+    with pytest.raises(xprof.MalformedTraceError):
+        xprof.analyze_capture(str(tmp_path))
+
+
+def test_torn_json_tail_typed_error(tmp_path):
+    d = tmp_path / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True)
+    with gzip.open(d / "h.trace.json.gz", "wt") as f:
+        f.write('{"traceEvents": [{"ph": "X", "name": "dot.1", "ts')  # torn
+    with pytest.raises(xprof.MalformedTraceError):
+        xprof.analyze_capture(str(tmp_path))
+
+
+def test_no_device_track_typed_error(tmp_path):
+    _write_capture(tmp_path, [
+        {"ph": "M", "pid": 9, "name": "process_name",
+         "args": {"name": "some_other_tool"}},
+        _x("whatever", 0, 10, pid=9),
+    ])
+    with pytest.raises(xprof.NoDeviceTrackError):
+        xprof.analyze_capture(str(tmp_path))
+
+
+def test_partial_report_counts_drops_never_raises(tmp_path):
+    """One good + one truncated + one trackless trace file: the report is
+    PARTIAL — good numbers, drops counted by kind, errors listed."""
+    _write_capture(tmp_path, _meta() + [_x("dot.1", 0, 100)], host="good")
+    bad = _write_capture(tmp_path, _meta() + [_x("dot.2", 0, 9)], host="trunc")
+    blob = open(bad, "rb").read()
+    with open(bad, "wb") as f:
+        f.write(blob[:20])
+    _write_capture(tmp_path, [_x("x", 0, 1, pid=99)], host="trackless")
+    r = xprof.analyze_capture(str(tmp_path))
+    assert r["analyzed"] == 1 and r["n_traces"] == 3
+    assert r["dropped"] == {"malformed_trace": 1, "no_device_track": 1}
+    assert len(r["errors"]) == 2
+    assert r["device_busy_s"] == pytest.approx(100e-6)
+    assert "dropped" in xprof.summary_line(r)
+
+
+def test_analyze_capture_quietly_never_raises(tmp_path):
+    rec, err = profile_lib.analyze_capture_quietly(str(tmp_path / "missing"))
+    assert rec is None
+    assert ("no *.trace.json.gz" in err) or ("not a directory" in err)
+    assert counters.get("xprof.analyze_errors") == 1
+    _write_capture(tmp_path, _meta() + [_x("dot.1", 0, 100)])
+    rec, err = profile_lib.analyze_capture_quietly(str(tmp_path))
+    assert err is None
+    assert rec["device_busy_s"] == pytest.approx(100e-6)
+    assert counters.get("xprof.analyses") == 1
+
+
+# -- the auto-analyze hook on a REAL capture ---------------------------------
+
+
+def test_hook_analyzes_real_cpu_capture(tmp_path):
+    """Acceptance: a real CPU-backend capture closed by the profiler's
+    stop path yields an attribution whose category seconds sum to device
+    busy time, attached to the stop event by the hook."""
+    import jax
+    import jax.numpy as jnp
+
+    prof = profile_lib.TriggeredProfiler(
+        str(tmp_path), window_steps=2, cooldown_steps=0, max_captures=1,
+        analyze=True,
+    )
+    prof.arm("anomaly_test")
+    ev = prof.on_step(0)
+    assert ev["event"] == "start"
+    f = jax.jit(lambda x, w: jnp.tanh(x @ w).sum())
+    x = jnp.ones((128, 128))
+    for _ in range(4):
+        jax.block_until_ready(f(x, x))
+    ev = prof.on_step(2)
+    assert ev["event"] == "stop"
+    analysis = ev.get("analysis")
+    assert analysis is not None, ev.get("analysis_error")
+    assert analysis["device_busy_s"] > 0
+    assert sum(analysis["categories"].values()) == pytest.approx(
+        analysis["device_busy_s"], abs=1e-9
+    )
+    assert analysis["categories"]["matmul_conv"] > 0  # the 128x128 dot
+    assert counters.get("xprof.analyses") == 1
+    # and the trainer-facing one-liner renders from the compact record
+    line = xprof.summary_line(analysis)
+    assert "device busy" in line and "matmul/conv" in line
+
+
+def test_hook_off_and_hook_failure_are_contained(tmp_path, monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    prof = profile_lib.TriggeredProfiler(
+        str(tmp_path / "a"), window_steps=1, max_captures=1, analyze=False,
+    )
+    prof.arm("x")
+    prof.on_step(0)
+    ev = prof.on_step(1)
+    assert ev["event"] == "stop"
+    assert "analysis" not in ev and "analysis_error" not in ev
+    # analyze on, fake backend → empty capture dir → contained error
+    prof2 = profile_lib.TriggeredProfiler(
+        str(tmp_path / "b"), window_steps=1, max_captures=1, analyze=True,
+    )
+    prof2.arm("y")
+    prof2.on_step(0)
+    ev = prof2.on_step(1)
+    assert ev["event"] == "stop"
+    assert "analysis" not in ev and ev["analysis_error"]
+    assert counters.get("xprof.analyze_errors") == 1
+
+
+def test_real_pmap_capture_attribution_and_overlap(tmp_path):
+    """A real 8-device CPU pmap+psum capture: collectives appear by kind,
+    the invariant holds, and the overlap fraction is well-formed."""
+    import jax
+    import jax.numpy as jnp
+
+    n = jax.local_device_count()
+    f = jax.pmap(
+        lambda x, w: jax.lax.psum(jnp.tanh(x @ w), "i").sum(), axis_name="i"
+    )
+    x = jnp.ones((n, 96, 96))
+    jax.block_until_ready(f(x, x))  # compile outside the window
+    jax.profiler.start_trace(str(tmp_path))
+    for _ in range(4):
+        jax.block_until_ready(f(x, x))
+    jax.profiler.stop_trace()
+    r = xprof.analyze_capture(str(tmp_path))
+    assert sum(r["categories"].values()) == pytest.approx(
+        r["device_busy_s"], abs=1e-9
+    )
+    assert r["collectives"].get("all-reduce", 0) > 0
+    assert r["categories"]["matmul_conv"] > 0
+    ov = r["overlap"]["overlap_frac"]
+    assert ov is not None and 0.0 <= ov <= 1.0
+
+
+# -- cost-model calibration --------------------------------------------------
+
+
+def test_calibration_rates_and_fractions():
+    from tpu_dist.obs import costmodel
+
+    analysis = {
+        "device_busy_s": 2.0,
+        "categories": {"matmul_conv": 1.0, "fusion_other": 0.5,
+                       "collective": 0.4, "infeed_outfeed": 0.1, "host": 0.0},
+        "collective_frac": 0.2,
+        "overlap_frac": 0.25,
+    }
+    cost = {"flops_per_step": 1e9, "bytes_per_step": 2e6}
+    cal = costmodel.calibration(
+        cost, analysis, steps=10, n_devices=2, peak=1e12
+    )
+    # concurrent-wall compute per step = 1.5s / 10 / 2 = 0.075s; the
+    # aggregate achieved rate over the AGGREGATE peak (peak×n_devices) —
+    # the same flops_per_step convention mfu() applies, so the two
+    # published efficiency numbers always agree
+    assert cal["cost.calibration_flops_per_s"] == pytest.approx(
+        1e9 / 0.075, rel=1e-3
+    )
+    assert cal["cost.calibration_compute_frac"] == pytest.approx(
+        1e9 / 0.075 / (1e12 * 2), abs=1e-4
+    )
+    # busy per device-step = 2.0 / 10 / 2 = 0.1s
+    assert cal["cost.calibration_bytes_per_s"] == pytest.approx(2e7, rel=1e-3)
+    assert cal["cost.calibration_collective_frac"] == 0.2
+    assert cal["cost.calibration_overlap_frac"] == 0.25
+    assert cal["cost.calibration_steps"] == 10
+
+
+def test_calibration_degrades_without_steps_cost_or_peak():
+    from tpu_dist.obs import costmodel
+
+    analysis = {"device_busy_s": 1.0, "collective_frac": 0.3,
+                "overlap_frac": 0.5,
+                "categories": {"matmul_conv": 0.7, "fusion_other": 0.0,
+                               "collective": 0.3, "infeed_outfeed": 0.0,
+                               "host": 0.0}}
+    # no steps: only the fraction gauges
+    cal = costmodel.calibration({"flops_per_step": 1e9}, analysis, steps=None)
+    assert set(cal) == {"cost.calibration_collective_frac",
+                       "cost.calibration_overlap_frac"}
+    # steps but no cost numbers: fractions + steps only
+    cal = costmodel.calibration({}, analysis, steps=4)
+    assert "cost.calibration_flops_per_s" not in cal
+    assert cal["cost.calibration_steps"] == 4
+    # unknown chip (CPU): rate yes, peak fraction omitted
+    cal = costmodel.calibration(
+        {"flops_per_step": 1e9}, analysis, steps=4, peak=None
+    )
+    assert "cost.calibration_flops_per_s" in cal
+    assert "cost.calibration_compute_frac" not in cal
+    assert costmodel.calibration({}, None, steps=4) == {}
+
+
+def test_calibration_gauges_reach_registry_and_exposition():
+    from tpu_dist.obs import costmodel, export
+
+    costmodel.publish_calibration({
+        "cost.calibration_overlap_frac": 0.4,
+        "cost.calibration_flops_per_s": 1.5e12,
+    })
+    snap = counters.snapshot()
+    assert snap["cost.calibration_overlap_frac"] == 0.4
+    text = export.render({
+        k: v for k, v in snap.items() if isinstance(v, (int, float))
+    })
+    assert "tpu_dist_cost_calibration_overlap_frac 0.4" in text
+    assert export.parse(text)["tpu_dist_cost_calibration_flops_per_s"] == 1.5e12
+
+
+# -- TD110 -------------------------------------------------------------------
+
+
+@pytest.mark.slow  # ~20 s: traces the DP step 4x + a REAL capture window
+# that the hook then analyzes; gates in the CI xprof step (no slow filter)
+def test_td110_xprof_hook_noop_gate():
+    from tpu_dist.analysis.jaxpr_audit import xprof_hook_noop_violations
+
+    assert xprof_hook_noop_violations() == []
+
+
+def test_td110_rule_registered():
+    from tpu_dist.analysis.jaxpr_audit import xprof_hook_noop_violations  # noqa: F401
+    from tpu_dist.analysis.rules import RULES
+
+    assert "TD110" in RULES
+    assert RULES["TD110"].name == "xprof-hook-not-noop"
+
+
+# -- summarize / compare / tail / pod / CLI over profile_analysis ------------
+
+
+def _epoch_rec(run_id, epoch, **kw):
+    return {"kind": "train_epoch", "epoch": epoch, "run_id": run_id,
+            "schema_version": 6, "ts": 10.0 + epoch, "rel_s": 1.0 + epoch,
+            "epoch_time": 1.0, "images_per_sec": 100.0, "loss": 1.0, **kw}
+
+
+def _analysis_rec(run_id, epoch, overlap, coll, **kw):
+    return {
+        "kind": "profile_analysis", "epoch": epoch, "run_id": run_id,
+        "schema_version": 6, "ts": 10.5 + epoch, "rel_s": 1.5 + epoch,
+        "reason": "anomaly_loss_spike", "dir": f"/prof/cap{epoch}",
+        "steps": 8, "device_busy_s": 1.0,
+        "categories": {"matmul_conv": 0.5, "fusion_other": 0.2,
+                       "collective": coll, "infeed_outfeed": 0.05,
+                       "host": 0.25 - coll},
+        "collectives": {"all-reduce": coll},
+        "collective_frac": coll, "overlap_frac": overlap,
+        "infeed_stall_s": 0.05,
+        "calibration": {"cost.calibration_overlap_frac": overlap,
+                        "cost.calibration_steps": 8},
+        **kw,
+    }
+
+
+def test_summarize_folds_profile_analysis_and_renders_table():
+    records = [
+        _epoch_rec("r1", 0),
+        _analysis_rec("r1", 0, 0.42, 0.15),
+        {"kind": "profile_analysis", "run_id": "r1", "schema_version": 6,
+         "ts": 12.0, "rel_s": 3.0, "epoch": 1, "reason": "retrace",
+         "dir": "/prof/cap1", "error": "no device track"},
+    ]
+    rep = summarize(records)
+    assert len(rep["profile_analyses"]) == 2
+    assert rep["profile_analyses"][0]["overlap_frac"] == 0.42
+    assert rep["skipped_kinds"] == {}        # v6 kind is KNOWN to this reader
+    text = format_text(rep)
+    assert "capture attribution" in text
+    assert "42.0%" in text                   # the overlap column
+    assert "calibration:" in text
+    assert "analysis FAILED: no device track" in text
+
+
+def test_compare_gates_on_injected_overlap_regression(tmp_path, capsys):
+    from tpu_dist.obs.__main__ import main as obs_main
+
+    base = tmp_path / "base.jsonl"
+    cand = tmp_path / "cand.jsonl"
+    for path, overlap, coll in ((base, 0.5, 0.2), (cand, 0.1, 0.2)):
+        with open(path, "w") as f:
+            for rec in (_epoch_rec("r", 0), _analysis_rec("r", 0, overlap, coll)):
+                f.write(json.dumps(rec) + "\n")
+    rc = obs_main(["compare", str(base), str(cand)])
+    out = capsys.readouterr().out
+    assert rc == 1                           # overlap collapsed → regression
+    assert "overlap_frac" in out and "REGRESSED" in out
+    # collective share growing is also a gated regression
+    with open(cand, "w") as f:
+        for rec in (_epoch_rec("r", 0), _analysis_rec("r", 0, 0.5, 0.6)):
+            f.write(json.dumps(rec) + "\n")
+    assert obs_main(["compare", str(base), str(cand)]) == 1
+    out = capsys.readouterr().out
+    assert "collective_frac" in out and "REGRESSED" in out
+    # identical logs: no regression, analysis metrics compared not skipped
+    assert obs_main(["compare", str(base), str(base)]) == 0
+
+
+def test_compare_skips_analysis_metrics_on_captureless_runs(tmp_path):
+    from tpu_dist.obs import compare as compare_lib
+
+    a = tmp_path / "a.jsonl"
+    with open(a, "w") as f:
+        f.write(json.dumps(_epoch_rec("r", 0)) + "\n")
+    result = compare_lib.compare_files(str(a), str(a))
+    rows = {r["metric"]: r["verdict"] for r in result["rows"]}
+    assert rows["overlap_frac"] == "skipped"
+    assert rows["collective_frac"] == "skipped"
+    assert result["regressions"] == 0
+
+
+def test_tail_shows_one_line_attribution():
+    from tpu_dist.obs.tail import TailState
+
+    st = TailState()
+    st.add([_epoch_rec("r", 0), _analysis_rec("r", 0, 0.37, 0.21)])
+    frame = st.render(None)
+    assert "capture analysis (anomaly_loss_spike)" in frame
+    assert "overlap 37%" in frame
+    st.add([{"kind": "profile_analysis", "reason": "retrace",
+             "error": "truncated gzip", "run_id": "r", "epoch": 1}])
+    assert "capture analysis FAILED (retrace): truncated gzip" in st.render(None)
+
+
+def test_pod_report_lists_captures_with_analysis_rollups(tmp_path):
+    from tpu_dist.obs import aggregate
+
+    stop = {"kind": "profile", "run_id": "r", "schema_version": 6,
+            "ts": 11.0, "rel_s": 2.0, "epoch": 0, "event": "stop",
+            "reason": "straggler", "start_step": 4, "stop_step": 12,
+            "steps": 8, "dir": "/prof/h1/cap0"}
+    hosts = [
+        ("h0", [_epoch_rec("r", 0)]),
+        ("h1", [_epoch_rec("r", 0), stop,
+                _analysis_rec("r", 0, 0.3, 0.25, dir="/prof/h1/cap0")]),
+    ]
+    rep = aggregate.pod_report(hosts)
+    assert rep["hosts"][1]["profile_analyses"][0]["overlap_frac"] == 0.3
+    text = aggregate.format_text(rep)
+    assert "captures on h1:" in text
+    assert "/prof/h1/cap0" in text
+    assert "overlap 30%" in text
+    assert "captures on h0:" not in text
+
+
+def test_xprof_cli_text_json_and_exit_codes(tmp_path, capsys):
+    from tpu_dist.obs.__main__ import main as obs_main
+
+    _write_capture(tmp_path, _meta() + [
+        _x("dot.1", 0, 60), _x("all-reduce.2", 60, 40),
+    ])
+    assert obs_main(["xprof", str(tmp_path)]) == 0
+    text = capsys.readouterr().out
+    assert "device busy" in text and "all-reduce" in text
+    assert obs_main(["xprof", str(tmp_path), "--format", "json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["categories"]["matmul_conv"] == pytest.approx(60e-6)
+    # a single trace FILE (e.g. pulled out of a capture) also analyzes
+    trace_file = xprof.find_traces(str(tmp_path))[0]
+    assert obs_main(["xprof", trace_file]) == 0
+    capsys.readouterr()
+    # unusable capture → 1; missing path → 2 (the broken-gate distinction)
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert obs_main(["xprof", str(empty)]) == 1
+    assert obs_main(["xprof", str(tmp_path / "missing")]) == 2
+
+
+def test_history_schema_v6_round_trip(tmp_path):
+    from tpu_dist.metrics.history import SCHEMA_VERSION, MetricsHistory
+
+    assert SCHEMA_VERSION == 6
+    path = str(tmp_path / "h.jsonl")
+    with MetricsHistory(path, run_id="r9") as h:
+        h.log("profile_analysis", epoch=0, reason="manual",
+              device_busy_s=0.5, overlap_frac=0.4,
+              categories={"matmul_conv": 0.5})
+    rec = json.loads(open(path).read())
+    assert rec["schema_version"] == 6
+    assert rec["kind"] == "profile_analysis"
+    assert rec["categories"] == {"matmul_conv": 0.5}
+
+
+# -- e2e: trainer auto-analysis on a real run --------------------------------
+
+
+@pytest.mark.slow  # >10s e2e (full trainer fit + compile): excluded from
+# the timed tier-1 gate; gates in the CI xprof step (no slow filter)
+def test_e2e_trainer_capture_emits_analysis_record_and_gauges(tmp_path, capsys):
+    """Acceptance: a short real run with a manual capture produces a
+    ``profile_analysis`` history record whose categories sum to busy,
+    ``cost.calibration_*`` gauges in the registry/log, the rank-0
+    summary line, and a summarize report with the attribution table."""
+    from tests.helpers import tiny_resnet
+    from tpu_dist.config import TrainConfig
+    from tpu_dist.obs.__main__ import main as obs_main
+    from tpu_dist.obs.summarize import load_records
+    from tpu_dist.train.trainer import Trainer, register_model
+
+    register_model(
+        "tiny_xprof_e2e", lambda num_classes=10: tiny_resnet(num_classes)
+    )
+    log = str(tmp_path / "run.jsonl")
+    prof_dir = str(tmp_path / "prof")
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_xprof_e2e", num_classes=10,
+        batch_size=64, epochs=1, steps_per_epoch=5, synthetic_n=640,
+        log_every=4, log_file=log, seed=0,
+        profile_dir=prof_dir, profile_steps="1:3",
+    )
+    Trainer(cfg).fit()
+    records, bad = load_records(log)
+    assert bad == 0
+    analyses = [r for r in records if r["kind"] == "profile_analysis"]
+    assert len(analyses) == 1, [r["kind"] for r in records]
+    pa = analyses[0]
+    assert pa["schema_version"] == 6
+    assert pa.get("error") is None
+    assert pa["device_busy_s"] > 0
+    assert sum(pa["categories"].values()) == pytest.approx(
+        pa["device_busy_s"], abs=1e-9
+    )
+    assert pa["steps"] == 2 and pa["reason"] == "manual"
+    # the calibration gauges landed in the record and the registry
+    cal = pa.get("calibration") or {}
+    assert cal.get("cost.calibration_steps") == 2
+    assert cal.get("cost.calibration_bytes_per_s", 0) > 0
+    snap = counters.snapshot()
+    assert snap.get("cost.calibration_bytes_per_s", 0) > 0
+    assert counters.get("xprof.analyses") == 1
+    # summarize renders the attribution table over the real log
+    capsys.readouterr()
+    assert obs_main(["summarize", log]) == 0
+    text = capsys.readouterr().out
+    assert "capture attribution" in text and "calibration:" in text

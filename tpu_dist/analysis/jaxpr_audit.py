@@ -769,6 +769,99 @@ def profile_trigger_noop_violations(mesh=None) -> list[Violation]:
     return out
 
 
+def xprof_hook_noop_violations(mesh=None) -> list[Violation]:
+    """TD110: the auto-analyze hook's cost contract, checked at the
+    program level (the TD105-TD109 armed-vs-off discipline applied to
+    ``obs/xprof.py`` via ``obs/profile.py``) — trace the data-parallel
+    step with no profiler, then drive a :class:`TriggeredProfiler` whose
+    analyze hook is ON through its whole life cycle: armed, capture
+    window OPEN (tracing mid-capture), and capture CLOSED — which fires
+    the real xprof analysis over the just-written capture directory plus
+    the cost-model calibration over its report — and trace again after.
+    All four jaxprs must be byte-identical: reading a capture back is
+    host-side gzip/JSON crunching, and the moment someone routes a
+    "handy" marker op or a calibration probe through the traced step,
+    this trips. The probe also asserts the hook actually RAN (a stop
+    event carrying ``analysis``/``analysis_error``) when the backend
+    could capture — a hook that silently stopped firing would make the
+    comparison vacuous."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.obs import costmodel
+    from tpu_dist.obs.profile import TriggeredProfiler
+
+    m = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+    fn, args = _dp_setup(m)
+    base = str(jax.make_jaxpr(fn)(*args))
+    tmp = tempfile.mkdtemp(prefix="td110_xprof_")
+    out: list[Violation] = []
+    try:
+        prof = TriggeredProfiler(
+            tmp, window_steps=2, cooldown_steps=0, max_captures=1,
+            analyze=True,
+        )
+        prof.arm("anomaly_loss_spike")
+        fn2, args2 = _dp_setup(m)
+        armed = str(jax.make_jaxpr(fn2)(*args2))
+        started = prof.on_step(0)  # opens a REAL device-trace window
+        # run real device work inside the window so the capture the hook
+        # analyzes holds an actual XLA timeline, not an empty trace
+        jax.block_until_ready(jax.jit(lambda x: x * 2.0)(jax.numpy.ones((8,))))
+        capturing = str(jax.make_jaxpr(fn2)(*args2))
+        stopped = prof.on_step(2)  # closes the window → auto-analysis runs
+        capture_ran = bool(started and started.get("event") == "start")
+        analysis_ran = bool(
+            stopped is not None
+            and ("analysis" in stopped or "analysis_error" in stopped)
+        )
+        if analysis_ran and stopped.get("analysis") is not None:
+            # the calibration path is part of the armed hook: fold the
+            # measured report into drift gauges exactly as the trainer does
+            costmodel.publish_calibration(costmodel.calibration(
+                {"flops_per_step": 1e9, "bytes_per_step": 1e6},
+                stopped["analysis"], steps=2, n_devices=1, peak=1e12,
+            ))
+        analyzed = str(jax.make_jaxpr(fn2)(*args2))
+        prof.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if capture_ran and not analysis_ran:
+        out.append(
+            Violation(
+                "TD110",
+                "<jaxpr:dp_xprof_hook_noop>",
+                0,
+                "the TD110 probe captured a real profiler window but the "
+                "auto-analyze hook produced neither an analysis nor an "
+                "analysis_error on the stop event — the armed-vs-off "
+                "comparison would be vacuous; the hook stopped firing "
+                "(obs/profile.py contract)",
+                snippet="auto-analyze hook did not fire",
+            )
+        )
+    if base != armed or (
+        capture_ran and (base != capturing or base != analyzed)
+    ):
+        out.append(
+            Violation(
+                "TD110",
+                "<jaxpr:dp_xprof_hook_noop>",
+                0,
+                "the traced train step CHANGED across the auto-analyze "
+                "hook's life cycle (armed / capture open / capture closed "
+                "and analyzed + calibration published) — capture read-back "
+                "must stay host-side file crunching (obs/xprof.py + "
+                "obs/profile.py contract)",
+                snippet="jaxpr(no_profiler) != jaxpr(xprof_hook_armed)",
+            )
+        )
+    return out
+
+
 def live_export_noop_violations(mesh=None) -> list[Violation]:
     """TD109: the live-telemetry cost contract, checked at the program
     level (the TD105-TD108 armed-vs-off discipline applied to
@@ -871,8 +964,8 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     Cross-case TD104 wire-ratio checks run over whichever quantized/
     reference pairs the report contains; full (unfiltered) runs also check
     the TD105 fault-injection, TD106 telemetry, TD107 device-metrics,
-    TD108 profiler-trigger, and TD109 live-export/alerting no-op
-    invariants."""
+    TD108 profiler-trigger, TD109 live-export/alerting, and TD110
+    capture-auto-analyze no-op invariants."""
     report: dict = {}
     violations: list[Violation] = []
     for name in names if names is not None else registered_cases():
@@ -895,6 +988,9 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
         violations.extend(vs)
         vs = live_export_noop_violations(mesh)
         report["dp_live_export_noop"] = {"identical": not vs}
+        violations.extend(vs)
+        vs = xprof_hook_noop_violations(mesh)
+        report["dp_xprof_hook_noop"] = {"identical": not vs}
         violations.extend(vs)
     return report, violations
 

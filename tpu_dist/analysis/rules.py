@@ -148,6 +148,15 @@ RULES: dict[str, Rule] = {
             "obs/alerts.py contract)",
         ),
         Rule(
+            "TD110",
+            "xprof-hook-not-noop",
+            "the traced train step differs between no profiler and a "
+            "triggered profiler whose AUTO-ANALYZE hook is armed — across "
+            "arm, capture-open, and capture-closed-and-analyzed states "
+            "(obs/xprof.py read-back + cost-model calibration must stay "
+            "host-side file crunching; obs/profile.py contract)",
+        ),
+        Rule(
             "TD104",
             "quantized-wire-bytes-over-budget",
             "gradient-collective payload bytes of a quantized wire format "

@@ -4,7 +4,7 @@ in ``utils/config.py:8``). One JSON object per line, append-only, rank-0
 only; consumable by pandas/jq/tensorboard-importers and by
 ``python -m tpu_dist.obs summarize`` (docs/observability.md).
 
-Schema (version 5): every record carries
+Schema (version 6): every record carries
 
 * ``ts`` — wall clock (epoch seconds; for humans and cross-run joins),
 * ``rel_s`` — monotonic seconds since this history opened (immune to NTP
@@ -23,7 +23,11 @@ the ``mfu`` field on ``train_epoch``; v4 added the fleet layer —
 ``goodput`` (per-window wall-clock buckets + a run-end ``final`` totals
 record) and ``profile`` (triggered device-capture events) kinds; v5
 added the live layer — the ``alert`` kind (a declarative threshold rule
-fired: rule/metric/value/threshold/sustained, ``obs/alerts.py``)
+fired: rule/metric/value/threshold/sustained, ``obs/alerts.py``); v6
+added the analytics layer — the ``profile_analysis`` kind (per-capture
+device-time attribution read back from the trace by ``obs/xprof.py``:
+category seconds, collectives by kind, comm/compute overlap fraction,
+infeed stall, top ops, cost-model ``calibration`` gauges)
 (docs/observability.md). Consumers (``obs summarize``/``compare``) read
 all versions: every addition is a new kind or optional field, never a
 changed one, and readers skip-with-count kinds they don't know — so a
@@ -46,7 +50,7 @@ import jax
 
 from tpu_dist.obs import counters as counters_lib
 
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 
 
 class MetricsHistory:

@@ -1,20 +1,22 @@
 """Run telemetry + device-side training health + the fleet layer + the
-LIVE layer: span tracing, subsystem counters, heartbeat, straggler
-detection, in-step health scalars (``device_stats``), cost/MFU
-accounting (``costmodel``), anomaly detection, the goodput ledger
-(``goodput``), triggered device profiling (``profile``), pod
+LIVE layer + the analytics layer: span tracing, subsystem counters,
+heartbeat, straggler detection, in-step health scalars
+(``device_stats``), cost/MFU accounting and capture calibration
+(``costmodel``), anomaly detection, the goodput ledger (``goodput``),
+triggered device profiling (``profile``), capture read-back analytics
+(``xprof`` — device-time attribution, comm/compute overlap), pod
 aggregation (``aggregate``), OpenMetrics/Prometheus export
 (``export``), declarative threshold alerting (``alerts``), and the
 ``python -m tpu_dist.obs summarize`` / ``compare`` / ``pod`` / ``tail``
-CLI.
+/ ``xprof`` CLI.
 
-Contract (audited by TD106/TD107/TD108/TD109): the host-telemetry half
-— goodput ledger, profiler trigger control, live exporter, and alert
-engine included — is host-side only: arming it leaves the traced train
-step byte-identical and adds no per-step device transfers. The one
-deliberately device-side piece, ``device_stats`` (opt-in
-``--device_metrics``), adds zero collectives and rides the existing
-single per-step metrics fetch. See ``docs/observability.md``.
+Contract (audited by TD106/TD107/TD108/TD109/TD110): the host-telemetry
+half — goodput ledger, profiler trigger control, capture auto-analysis,
+live exporter, and alert engine included — is host-side only: arming it
+leaves the traced train step byte-identical and adds no per-step device
+transfers. The one deliberately device-side piece, ``device_stats``
+(opt-in ``--device_metrics``), adds zero collectives and rides the
+existing single per-step metrics fetch. See ``docs/observability.md``.
 """
 
 from tpu_dist.obs import counters, goodput, spans  # noqa: F401

@@ -32,9 +32,17 @@ Subcommands::
     pod <host0.jsonl> <host1.jsonl> ... [--heartbeat hb.json ...]
         [--trace-out pod_trace.json] [--format text|json]
         Cross-host aggregation: per-host goodput ledgers side by side,
-        per-epoch skew with phase attribution, heartbeat liveness, and
+        per-epoch skew with phase attribution, heartbeat liveness,
+        per-host profiler captures with their analysis rollups, and
         (with --trace-out) one merged Perfetto timeline with a track per
         host, aligned on the shared run clock.
+
+    xprof <capture_dir | trace.json[.gz]> [--top K] [--format text|json]
+        Offline device-time attribution of a ``jax.profiler`` capture
+        (``obs/xprof.py``): per-category device seconds, collectives by
+        kind, comm/compute overlap fraction, infeed stall, top ops.
+        Accepts a capture directory (``plugins/profile/...`` inside —
+        multi-host trees included) or one Chrome trace file.
 
 Exit codes: 0 ok, 1 empty/unusable input (or, for ``compare``, a
 regression), 2 bad invocation or I/O error.
@@ -45,6 +53,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from tpu_dist.obs import summarize as summ
@@ -120,7 +129,48 @@ def main(argv=None) -> int:
         help="also write one merged Perfetto trace (a track per host)",
     )
     pd.add_argument("--format", choices=("text", "json"), default="text")
+    xp = sub.add_parser(
+        "xprof",
+        help="device-time attribution of a jax.profiler capture",
+    )
+    xp.add_argument(
+        "capture",
+        help="capture directory (plugins/profile/<run>/*.trace.json.gz "
+             "inside, pod-collected per-host trees included) or a single "
+             "Chrome trace .json/.json.gz file",
+    )
+    xp.add_argument("--top", type=int, default=10, metavar="K",
+                    help="ops listed in the top-self-time table")
+    xp.add_argument("--format", choices=("text", "json"), default="text")
     args = ap.parse_args(argv)
+
+    if args.cmd == "xprof":
+        from tpu_dist.obs import xprof as xprof_lib
+
+        if not os.path.exists(args.capture):
+            print(f"tpu_dist.obs: cannot read {args.capture}: no such "
+                  "file or directory", file=sys.stderr)
+            return 2
+        try:
+            if os.path.isdir(args.capture):
+                report = xprof_lib.analyze_capture(args.capture, top_k=args.top)
+            else:
+                report = xprof_lib.analyze_trace_file(
+                    args.capture, top_k=args.top
+                )
+        except xprof_lib.CaptureError as e:
+            # typed: empty capture / all traces malformed / no device track
+            print(f"tpu_dist.obs: {e}", file=sys.stderr)
+            return 1
+        except OSError as e:
+            print(f"tpu_dist.obs: cannot read {args.capture}: {e}",
+                  file=sys.stderr)
+            return 2
+        if args.format == "json":
+            print(json.dumps(report, indent=2))
+        else:
+            print(xprof_lib.format_text(report))
+        return 0
 
     if args.cmd == "tail":
         from tpu_dist.obs import tail as tail_lib

@@ -150,6 +150,7 @@ def pod_report(
             "stragglers": rep.get("stragglers", []),
             "anomalies": len(rep.get("anomalies", [])),
             "profiles": rep.get("profiles", []),
+            "profile_analyses": rep.get("profile_analyses", []),
             "skipped_kinds": rep.get("skipped_kinds", {}),
         })
     fracs = [
@@ -228,6 +229,42 @@ def format_text(report: dict) -> str:
             + f" {cell(h.get('images_per_sec_mean'), '.1f', 9)}"
             + f" {cell(gp.get('n_segments'), 'd', 4)}"
         )
+    # per-host profiler captures: paths + the xprof analysis rollup, so
+    # the pod view answers WHERE each capture lives and WHAT it said —
+    # not just who heartbeats and who straggles
+    for h in report["hosts"]:
+        caps = [p for p in h.get("profiles", []) if p.get("event") == "stop"]
+        analyses = {
+            pa.get("dir"): pa for pa in h.get("profile_analyses", [])
+        }
+        fails = [p for p in h.get("profiles", []) if p.get("event") == "error"]
+        if not caps and not fails:
+            continue
+        lines.append(f"captures on {h['host']}:")
+        for p in caps:
+            lines.append(
+                f"  epoch {p.get('epoch')} ({p.get('reason')}): "
+                f"{p.get('steps')} step(s) → {p.get('dir')}"
+            )
+            pa = analyses.get(p.get("dir"))
+            if pa and not pa.get("error"):
+                lines.append(
+                    "    busy "
+                    f"{cell(pa.get('device_busy_s'), '.3f', 0).strip()}s, "
+                    "collectives "
+                    f"{cell(pa.get('collective_frac'), '.0%', 0).strip()}, "
+                    "overlap "
+                    f"{cell(pa.get('overlap_frac'), '.0%', 0).strip()}, "
+                    "infeed stall "
+                    f"{cell(pa.get('infeed_stall_s'), '.3f', 0).strip()}s"
+                )
+            elif pa:
+                lines.append(f"    analysis FAILED: {pa['error']}")
+        for p in fails:
+            lines.append(
+                f"  epoch {p.get('epoch')} ({p.get('reason')}): capture "
+                f"FAILED: {p.get('error')}"
+            )
     for s in report.get("epoch_skew", []):
         mark = " <-- STRAGGLER" if s["skew"] > 1.5 else ""
         lines.append(

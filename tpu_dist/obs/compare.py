@@ -46,6 +46,14 @@ REPORT_METRICS: Tuple[Tuple[str, str, float], ...] = (
     ("final_loss", "lower", 0.02),
     ("final_val_top1", "higher", 0.5),
     ("goodput_frac", "higher", 0.01),
+    # capture-derived schedule health (obs/xprof.py, profile_analysis
+    # records): mean comm/compute overlap — LOWER overlap means newly
+    # serialized collectives — and the collectives' share of device busy
+    # time, which growing means the step got more communication-bound.
+    # Absolute slacks because both are fractions that wobble a few points
+    # run to run on quiet captures.
+    ("overlap_frac", "higher", 0.05),
+    ("collective_frac", "lower", 0.03),
 )
 
 #: the ``--goodput`` gate's metric set: time-to-useful-work only. The
@@ -80,6 +88,10 @@ def report_scalars(report: dict) -> dict:
         if isinstance(r.get("val_top1"), (int, float))
     ]
     gp = report.get("goodput") or {}
+    pas = [
+        p for p in (report.get("profile_analyses") or [])
+        if not p.get("error")
+    ]
     return {
         "images_per_sec_mean": report["totals"].get("images_per_sec_mean"),
         "step_time_p50_s": _mean([r.get("step_time_p50_s") for r in epochs]),
@@ -92,6 +104,10 @@ def report_scalars(report: dict) -> dict:
         # the run-level ledger's fraction (obs/goodput.py): resumed
         # segments folded, restart gaps counted against it
         "goodput_frac": gp.get("goodput_frac"),
+        # capture-derived means (profile_analysis records); None — and
+        # therefore a skipped row, never a fake pass — on capture-less runs
+        "overlap_frac": _mean([p.get("overlap_frac") for p in pas]),
+        "collective_frac": _mean([p.get("collective_frac") for p in pas]),
     }
 
 

@@ -236,6 +236,98 @@ def install_compile_listener() -> bool:
     return True
 
 
+def _sig(v: float, digits: int = 4) -> float:
+    """Round to significant digits — calibration rates span 1e3..1e15."""
+    return float(f"{v:.{digits}g}")
+
+
+def calibration(
+    cost: Optional[dict],
+    analysis: Optional[dict],
+    *,
+    steps: Optional[int] = None,
+    n_devices: int = 1,
+    peak: Optional[float] = None,
+) -> dict:
+    """Calibrate the static cost model against a measured capture: divide
+    the xprof attribution's measured category seconds into the predicted
+    per-step FLOPs/bytes (``step_cost``) and return achieved-rate /
+    drift gauges, keyed by their registry names:
+
+    * ``cost.calibration_flops_per_s`` — AGGREGATE achieved FLOP/s over
+      the capture's COMPUTE seconds only (matmul/conv + fusion), i.e.
+      what the hardware sustains when it is actually computing — the
+      number an ``--auto_shard`` planner should price compute with,
+      where MFU (whole-step wall over chip peak) prices nothing.
+    * ``cost.calibration_compute_frac`` — that rate over the AGGREGATE
+      chip peak (``peak × n_devices``, :func:`mfu`'s denominator —
+      ``flops_per_step`` is treated as the step's total across devices,
+      the SAME convention ``mfu`` applies to the same ``step_cost``
+      dict, so the two published efficiency numbers always agree);
+      omitted on unknown chips (CPU emulation).
+    * ``cost.calibration_bytes_per_s`` — aggregate achieved bytes/s:
+      the cost model's per-step byte count over measured busy seconds.
+    * ``cost.calibration_collective_frac`` / ``_overlap_frac`` — the
+      capture's collective share of device busy time and comm/compute
+      overlap fraction, the two schedule-quality drift signals.
+    * ``cost.calibration_steps`` — steps the capture covered (the
+      normalization the rates used).
+
+    ``analysis`` is the compact xprof record; ``steps`` the step count
+    the capture covered (rate gauges need it; the fraction gauges work
+    without). Returns {} when nothing is computable — callers publish
+    whatever comes back and never fail a capture on a thin one."""
+    out: dict = {}
+    if not analysis:
+        return out
+    cf = analysis.get("collective_frac")
+    if isinstance(cf, (int, float)):
+        out["cost.calibration_collective_frac"] = cf
+    ov = analysis.get("overlap_frac")
+    if ov is None and isinstance(analysis.get("overlap"), dict):
+        ov = analysis["overlap"].get("overlap_frac")
+    if isinstance(ov, (int, float)):
+        out["cost.calibration_overlap_frac"] = ov
+    busy = analysis.get("device_busy_s")
+    cats = analysis.get("categories") or {}
+    if not steps or not isinstance(busy, (int, float)) or busy <= 0:
+        return out
+    out["cost.calibration_steps"] = int(steps)
+    n_devices = max(int(n_devices), 1)
+    cost = cost or {}
+    # measured seconds are SUMMED across the capture's devices, so the
+    # concurrent-wall compute time per step is compute_s/steps/n_devices;
+    # flops_per_step is the step's aggregate count (the mfu convention),
+    # so the ratio is the aggregate achieved rate
+    compute_s = (
+        float(cats.get("matmul_conv", 0.0)) + float(cats.get("fusion_other", 0.0))
+    )
+    flops = cost.get("flops_per_step")
+    if isinstance(flops, (int, float)) and flops > 0 and compute_s > 0:
+        achieved = flops / (compute_s / steps / n_devices)
+        out["cost.calibration_flops_per_s"] = _sig(achieved)
+        if peak is None:
+            peak = chip_peak_flops()
+        if peak:
+            out["cost.calibration_compute_frac"] = round(
+                achieved / (peak * n_devices), 4
+            )
+    byts = cost.get("bytes_per_step")
+    if isinstance(byts, (int, float)) and byts > 0:
+        out["cost.calibration_bytes_per_s"] = _sig(
+            byts / (busy / steps / n_devices)
+        )
+    return out
+
+
+def publish_calibration(gauges: dict) -> None:
+    """Stamp :func:`calibration`'s gauges into the telemetry registry —
+    every later history record and OpenMetrics exposition carries them
+    (``counters.snapshot`` feeds both)."""
+    for name, v in gauges.items():
+        counters_lib.set_gauge(name, v)
+
+
 def publish(cost: Optional[dict]) -> None:
     """Stamp a step-cost dict into the telemetry gauges
     (``device.flops_per_step`` / ``device.bytes_per_step``) so every

@@ -28,7 +28,11 @@ armed vs off.
 
 Metric naming: every name is prefixed ``tpu_dist_`` and sanitized to
 the OpenMetrics grammar (dots → underscores), e.g. the
-``loader.data_wait_s`` counter exports as ``tpu_dist_loader_data_wait_s``.
+``loader.data_wait_s`` counter exports as ``tpu_dist_loader_data_wait_s``
+and the capture-calibration gauges (``cost.calibration_*``, set by the
+auto-analyze hook via ``obs/costmodel.py``) as
+``tpu_dist_cost_calibration_*`` — the registry snapshot carries them
+into every exposition with no per-metric plumbing.
 Alert states export as ``tpu_dist_alert_active{rule="<name>"}`` 0/1
 gauges (``obs/alerts.py``).  Stdlib-only on purpose — the HTTP thread
 and the textfile writer must never import jax.

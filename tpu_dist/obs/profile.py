@@ -20,24 +20,158 @@ window of steps:
   ``--profile_max_captures`` triggered captures run per process — an
   anomaly storm cannot turn the run into one endless trace.
 
+This module is the ONE owner of the ``jax.profiler`` arming surface:
+the triggered/manual capture machinery below, the :func:`trace`
+blanket-capture context manager behind ``--profile_dir`` alone, the
+:func:`annotate_step` marker, and the :class:`StepTimer` step clock
+(all formerly ``tpu_dist/metrics/profiler.py`` — folded here so exactly
+one module can hold the profiler lock).
+
+Closing the loop: a capture answers nothing until something reads it
+back, so every capture close runs the ``obs/xprof.py`` analyzer over
+the freshly written directory (:func:`analyze_capture_quietly`) and
+attaches the attribution to the stop event — the trainer turns that
+into a ``profile_analysis`` history record (schema v6) and a rank-0
+summary line. Analysis failures are counted (``xprof.analyze_errors``)
+and reported in the event, never raised: forensics must not kill the
+training process that captured them.
+
 Cost contract: arming a trigger is host bookkeeping only, and even an
 OPEN capture window only observes the program XLA already built — the
 jaxpr-audit rule **TD108** proves the traced step is byte-identical with
 a trigger armed and with a capture in flight (the TD105-TD107
-discipline). Capture failures (no profiler backend, a second trace
-already active) are counted and disable further captures; they must
-never kill the training step that tripped them.
+discipline), and **TD110** extends the same proof across the armed
+auto-analyze hook (a capture closed AND analyzed mid-run). Capture
+failures (no profiler backend, a second trace already active) are
+counted and disable further captures; they must never kill the training
+step that tripped them.
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
-from typing import Optional, Tuple
+import time
+from typing import Iterator, Optional, Tuple
 
 from tpu_dist.obs import counters
 
 #: Trigger kinds ``--profile_trigger`` may name (``auto`` = all three).
 TRIGGER_KINDS = ("anomaly", "straggler", "retrace")
+
+
+# --------------------------------------------------------------------------
+# Blanket capture + step annotation (formerly tpu_dist/metrics/profiler.py)
+# --------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def trace(logdir: str, *, primary_only: bool = True) -> Iterator[None]:
+    """Profile a whole region to ``logdir`` (the ``--profile_dir`` alone
+    epoch-0 blanket capture; view in TensorBoard's profile tab or feed to
+    ``obs xprof``). ``primary_only`` keeps the rank-0 discipline: other
+    processes run the region untraced."""
+    import jax  # noqa: PLC0415
+
+    if primary_only and jax.process_index() != 0:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate_step(step: int):
+    """Mark a training step in captures (shows as a named range)."""
+    import jax  # noqa: PLC0415
+
+    return jax.profiler.StepTraceAnnotation("train_step", step_num=step)
+
+
+class StepTimer:
+    """Steady-state throughput: skips warmup/compile steps, no per-step
+    device sync (the device queue keeps the TPU busy; only ``finish``
+    blocks).
+
+    Beyond the mean, each post-warmup ``tick`` records a per-step lap on
+    the monotonic clock, so the trainer's epoch summary can report tail
+    latency (:meth:`percentiles`) — the p99 is where input stalls and
+    stragglers live; a mean hides them completely."""
+
+    def __init__(self, warmup_steps: int = 3):
+        self.warmup_steps = warmup_steps
+        self._seen = 0
+        self._t0: Optional[float] = None
+        self._last: Optional[float] = None
+        self.steps = 0
+        self.laps: list = []  # post-warmup per-step seconds, tick-to-tick
+
+    def tick(self) -> None:
+        now = time.perf_counter()
+        self._seen += 1
+        if self._seen == self.warmup_steps:
+            self._t0 = now
+            self._last = now
+        elif self._seen > self.warmup_steps:
+            self.steps += 1
+            if self._last is not None:
+                self.laps.append(now - self._last)
+            self._last = now
+
+    def finish(self, blocker=None) -> Optional[float]:
+        """Seconds per steady-state step (None if too few steps).
+        ``blocker``: array to ``block_until_ready`` before the clock."""
+        if blocker is not None:
+            import jax  # noqa: PLC0415
+
+            jax.block_until_ready(blocker)
+        if self._t0 is None or self.steps == 0:
+            return None
+        return (time.perf_counter() - self._t0) / self.steps
+
+    def percentiles(self, qs=(50, 95, 99)) -> Optional[dict]:
+        """``{"p50": s, "p95": s, "p99": s}`` over the recorded laps
+        (nearest-rank; None with no laps — e.g. a 1-step epoch where
+        every step was warmup)."""
+        if not self.laps:
+            return None
+        laps = sorted(self.laps)
+        n = len(laps)
+        return {
+            f"p{q}": laps[min(n - 1, max(0, int(round(q / 100.0 * n)) - 1))]
+            for q in qs
+        }
+
+
+# --------------------------------------------------------------------------
+# Auto-analysis of a closed capture (obs/xprof.py behind a never-raise wall)
+# --------------------------------------------------------------------------
+
+
+def analyze_capture_quietly(
+    capture_dir: str, top_k: int = 10
+) -> Tuple[Optional[dict], Optional[str]]:
+    """Run the xprof analyzer over a freshly closed capture directory.
+    Returns ``(compact_record, None)`` on success or ``(None, error)``
+    on any failure — NEVER raises (the hook runs inside the training
+    process; ``xprof.analyze_errors`` counts what went wrong, and
+    per-trace drops inside a partial report count into
+    ``xprof.dropped_traces``)."""
+    try:
+        from tpu_dist.obs import xprof  # noqa: PLC0415
+
+        report = xprof.analyze_capture(capture_dir, top_k=top_k)
+        rec = xprof.compact(report)
+    except Exception as e:
+        counters.inc("xprof.analyze_errors")
+        return None, str(e)[:300]
+    counters.inc("xprof.analyses")
+    dropped = sum((report.get("dropped") or {}).values())
+    if dropped:
+        counters.inc("xprof.dropped_traces", dropped)
+    return rec, None
 
 
 def parse_trigger(spec: str) -> frozenset:
@@ -96,6 +230,7 @@ class TriggeredProfiler:
         cooldown_steps: int = 200,
         max_captures: int = 3,
         manual_range: Optional[Tuple[int, int]] = None,
+        analyze: bool = True,
     ):
         if window_steps < 1:
             raise ValueError(f"window_steps must be >= 1, got {window_steps}")
@@ -106,6 +241,7 @@ class TriggeredProfiler:
         self.cooldown_steps = cooldown_steps
         self.max_captures = max_captures
         self.manual_range = manual_range
+        self.analyze = analyze  # run obs/xprof over every closed capture
         self.captures = 0            # triggered captures taken (cap applies)
         self._armed: Optional[str] = None
         self._active: Optional[dict] = None  # {"reason","start_step","dir"}
@@ -209,11 +345,23 @@ class TriggeredProfiler:
             counters.inc("profile.errors")
             return {"event": "error", "reason": info["reason"],
                     "error": str(e)[:200]}
-        return {
+        ev = {
             "event": "stop", "reason": info["reason"],
             "start_step": info["start_step"], "stop_step": step,
             "steps": step - info["start_step"], "dir": info["dir"],
         }
+        if self.analyze:
+            # the auto-analyze hook: read the capture back NOW, while the
+            # trainer still knows which steps it covered. Host-side file
+            # crunching on a closed capture — TD110 proves the traced step
+            # is byte-identical across the whole arm→capture→analyze
+            # cycle; failures are counted, reported, and never raised.
+            analysis, err = analyze_capture_quietly(info["dir"])
+            if analysis is not None:
+                ev["analysis"] = analysis
+            elif err is not None:
+                ev["analysis_error"] = err
+        return ev
 
     def close(self) -> Optional[dict]:
         """Stop any in-flight capture (fit exit, including error exits) —

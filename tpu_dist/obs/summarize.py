@@ -29,13 +29,14 @@ from tpu_dist.obs import goodput as goodput_lib
 #: kinds summarized; their unknown kinds are skipped with a count — the
 #: forward-compat contract that lets v3 tooling read v4 logs and vice
 #: versa (every schema bump is additive).
-SUPPORTED_SCHEMA = 5
+SUPPORTED_SCHEMA = 6
 
 #: Record kinds this reader folds into the report. Anything else is
 #: counted into ``skipped_kinds`` — never an error, never silent.
 KNOWN_KINDS = frozenset((
     "train_epoch", "eval", "straggler", "anomaly", "device_stats",
     "auto_recover", "spans", "goodput", "profile", "alert",
+    "profile_analysis",
 ))
 
 
@@ -70,6 +71,7 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
     anomalies: List[dict] = []
     alerts: List[dict] = []
     profiles: List[dict] = []
+    profile_analyses: List[dict] = []
     goodput_epochs: List[dict] = []
     dstats: dict = {}  # epoch -> per-epoch device_stats aggregate
     recoveries = 0
@@ -137,6 +139,18 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
                 k: rec.get(k)
                 for k in ("epoch", "event", "reason", "start_step",
                           "stop_step", "steps", "dir", "error")
+                if rec.get(k) is not None
+            })
+        elif kind == "profile_analysis":
+            # the capture read back (obs/xprof.py, schema v6): category
+            # attribution + overlap + calibration per capture
+            profile_analyses.append({
+                k: rec.get(k)
+                for k in ("epoch", "reason", "dir", "steps",
+                          "device_busy_s", "categories", "collectives",
+                          "collective_frac", "overlap_frac",
+                          "infeed_stall_s", "top_ops", "calibration",
+                          "dropped", "error")
                 if rec.get(k) is not None
             })
         elif kind == "goodput" and not rec.get("final"):
@@ -210,6 +224,7 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
         "anomalies": anomalies,
         "alerts": alerts,
         "profiles": profiles,
+        "profile_analyses": profile_analyses,
         "goodput_epochs": goodput_epochs,
         # run-level goodput ledger: resumed segments folded, restart gaps
         # attributed to preempt_s (None on a goodput-less / pre-v4 log)
@@ -333,6 +348,47 @@ def format_text(report: dict) -> str:
                 f"profile: capture FAILED ({pr.get('reason')}): "
                 f"{pr.get('error')}"
             )
+    pas = report.get("profile_analyses") or []
+    if pas:
+        from tpu_dist.obs import xprof as xprof_lib  # stdlib-only
+
+        lines.append("capture attribution (device seconds, obs/xprof.py):")
+        cats = list(xprof_lib.CATEGORIES)
+        lines.append(
+            f"{'epoch':>5} {'reason':>16} {'busy_s':>9} "
+            + " ".join(f"{c[:10]:>10}" for c in cats)
+            + f" {'overlap':>8} {'infeed_s':>9}"
+        )
+        for pa in pas:
+            if pa.get("error"):
+                lines.append(
+                    f"  epoch {pa.get('epoch')} ({pa.get('reason')}): "
+                    f"analysis FAILED: {pa['error']}"
+                )
+                continue
+            pc = pa.get("categories") or {}
+            lines.append(
+                f"{_fmt(pa.get('epoch'), 'd', 5)} "
+                f"{str(pa.get('reason') or '-')[:16]:>16} "
+                f"{_fmt(pa.get('device_busy_s'), '.4f', 9)} "
+                + " ".join(_fmt(pc.get(c), ".4f", 10) for c in cats)
+                + f" {_fmt(pa.get('overlap_frac'), '.1%', 8)}"
+                + f" {_fmt(pa.get('infeed_stall_s'), '.4f', 9)}"
+            )
+            cal = pa.get("calibration") or {}
+            if cal:
+                body = ", ".join(
+                    f"{k.split('calibration_', 1)[-1]}={v:g}"
+                    if isinstance(v, (int, float)) else f"{k}={v}"
+                    for k, v in sorted(cal.items())
+                )
+                lines.append(f"      calibration: {body}")
+            if pa.get("dropped"):
+                n = sum(pa["dropped"].values())
+                lines.append(
+                    f"      WARNING: {n} trace file(s) dropped during "
+                    f"analysis ({pa['dropped']})"
+                )
     gp_epochs = report.get("goodput_epochs") or []
     if gp_epochs:
         lines.append("goodput (seconds per window):")

@@ -165,6 +165,28 @@ class TailState:
                         f"profile captured {rec.get('steps')} step(s) "
                         f"({rec.get('reason')})"
                     )
+            elif kind == "profile_analysis":
+                # one-line device-time attribution per closed capture
+                # (obs/xprof.py auto-analysis, schema v6)
+                if rec.get("error"):
+                    self._event(
+                        f"capture analysis FAILED ({rec.get('reason')}): "
+                        f"{rec.get('error')}"
+                    )
+                else:
+                    busy = rec.get("device_busy_s")
+                    cf = rec.get("collective_frac")
+                    ov = rec.get("overlap_frac")
+                    fmt = lambda v, s: (  # noqa: E731
+                        format(v, s) if isinstance(v, (int, float)) else "-"
+                    )
+                    self._event(
+                        f"capture analysis ({rec.get('reason')}): device "
+                        f"busy {fmt(busy, '.3f')}s, collectives "
+                        f"{fmt(cf, '.0%')}, overlap {fmt(ov, '.0%')}, "
+                        f"infeed stall "
+                        f"{fmt(rec.get('infeed_stall_s'), '.3f')}s"
+                    )
             elif kind == "auto_recover":
                 self._event(
                     f"auto-recover at epoch {ep} (lr_scale "

@@ -44,7 +44,7 @@ from tpu_dist.data import (
 )
 from tpu_dist.evaluation import validate
 from tpu_dist.metrics import AverageMeter, rank0_print
-from tpu_dist.metrics.profiler import StepTimer
+from tpu_dist.obs.profile import StepTimer
 from tpu_dist.nn import resnet18, resnet34, resnet50
 from tpu_dist.obs import costmodel as costmodel_lib
 from tpu_dist.obs import counters as counters_lib
@@ -1766,7 +1766,14 @@ class Trainer:
     def _note_profile_event(self, ev: dict, epoch: int, step) -> None:
         """A triggered-profiler window opened/closed/failed: rank-0 line +
         a ``profile`` history record (schema v4), so ``obs summarize`` and
-        the pod report can say WHEN and WHY each capture ran."""
+        the pod report can say WHEN and WHY each capture ran. A stop
+        event carrying the auto-analysis (obs/profile.py hook) peels it
+        off into its own ``profile_analysis`` record + summary line +
+        calibration gauges — the ``profile`` record stays the small
+        when/why stamp it always was."""
+        ev = dict(ev)
+        analysis = ev.pop("analysis", None)
+        analysis_error = ev.pop("analysis_error", None)
         if ev.get("event") == "start":
             rank0_print(
                 f"=> profiler capture started ({ev.get('reason')}) at "
@@ -1786,6 +1793,57 @@ class Trainer:
             )
         if self._history is not None:
             self._history.log("profile", epoch=epoch, **ev)
+        if ev.get("event") == "stop":
+            self._note_capture_analysis(
+                analysis, analysis_error, epoch=epoch,
+                reason=ev.get("reason"), capture_dir=ev.get("dir"),
+                steps=ev.get("steps"),
+            )
+
+    def _note_capture_analysis(
+        self, analysis, error, *, epoch: int, reason, capture_dir, steps,
+    ) -> None:
+        """The read-back half of a capture (``obs/xprof.py``): rank-0
+        attribution line, ``profile_analysis`` history record (schema
+        v6), and cost-model calibration gauges (``cost.calibration_*`` —
+        measured category seconds divided into the predicted per-step
+        FLOPs/bytes, the drift signal a later ``--auto_shard`` planner
+        prices layouts with). Analysis failures were counted by the hook
+        already; here they surface as a warning + an error-stamped
+        record, never an exception — forensics must not kill training."""
+        if analysis is None:
+            if error:
+                rank0_print(
+                    f"WARNING: capture analysis failed ({reason}): {error}"
+                )
+                if self._history is not None:
+                    self._history.log(
+                        "profile_analysis", epoch=epoch, reason=reason,
+                        dir=capture_dir, error=error,
+                    )
+            return
+        from tpu_dist.obs import xprof as xprof_lib  # noqa: PLC0415
+
+        cal = costmodel_lib.calibration(
+            self._step_cost, analysis,
+            steps=steps, n_devices=jax.local_device_count(),
+        )
+        if cal:
+            costmodel_lib.publish_calibration(cal)
+        rank0_print(
+            f"=> capture analysis ({reason}): "
+            + xprof_lib.summary_line(analysis)
+        )
+        if self._history is not None:
+            rec = dict(analysis)
+            if cal:
+                rec["calibration"] = cal
+            if steps is not None:
+                rec["steps"] = steps
+            self._history.log(
+                "profile_analysis", epoch=epoch, reason=reason,
+                dir=capture_dir, **rec,
+            )
 
     def _apply_step_faults(self, epoch: int, step: int, lr: float) -> None:
         """Host-side --fault_plan actions at the step grain. A matching
@@ -2417,10 +2475,23 @@ class Trainer:
                 cfg.profile_dir and epoch == self.start_epoch
                 and self._profiler is None
             ):
-                from tpu_dist.metrics.profiler import trace  # noqa: PLC0415
+                from tpu_dist.obs.profile import (  # noqa: PLC0415
+                    analyze_capture_quietly,
+                    trace,
+                )
 
                 with trace(cfg.profile_dir):
                     last = self.train_epoch(epoch, start_step=start_step)
+                if mesh_lib.is_primary():
+                    # the blanket capture gets the same read-back as a
+                    # triggered one: attribution record + summary line +
+                    # calibration gauges (obs/xprof.py)
+                    analysis, a_err = analyze_capture_quietly(cfg.profile_dir)
+                    self._note_capture_analysis(
+                        analysis, a_err, epoch=epoch, reason="profile_dir",
+                        capture_dir=cfg.profile_dir,
+                        steps=last.get("steps"),
+                    )
             else:
                 last = self.train_epoch(epoch, start_step=start_step)
             self._in_epoch = False
