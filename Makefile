@@ -1,6 +1,6 @@
 # Convenience targets; CI / the driver call the underlying commands directly.
 
-.PHONY: test quick bench csrc clean lint pod-report monitor profile-report
+.PHONY: test quick bench csrc clean lint pod-report monitor profile-report elastic-drill
 
 csrc:
 	$(MAKE) -C tpu_dist/csrc
@@ -33,6 +33,14 @@ pod-report:
 # collectives by kind, comm/compute overlap, top ops)
 profile-report:
 	python -m tpu_dist.obs xprof $(CAPTURE) $(if $(TOP),--top $(TOP))
+
+# The elastic proof, locally: preempt an 8-device ZeRO-1 run at step k
+# (deterministic sigterm fault), resume at 4 devices (checkpoint remapped
+# onto the new dp extent), assert the continued loss trajectory matches
+# the uninterrupted golden run (docs/resilience.md "Elastic training"):
+#   make elastic-drill [WORKDIR=/tmp/elastic_drill]
+elastic-drill:
+	python -m tpu_dist.elastic.drill --workdir $(or $(WORKDIR),/tmp/elastic_drill)
 
 # Follow a LIVE run from another terminal:
 #   make monitor LOG=run.jsonl [HB=hb.json]

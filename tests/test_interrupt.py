@@ -22,7 +22,7 @@ def test_interrupt_saves_emergency_checkpoint(tmp_path, monkeypatch):
     calls = {"n": 0}
     orig = t.train_epoch
 
-    def interrupting(epoch, start_step=0):
+    def interrupting(epoch, start_step=0, start_examples=0):
         calls["n"] += 1
         if calls["n"] == 2:
             raise KeyboardInterrupt
@@ -52,7 +52,7 @@ def test_interrupt_in_first_epoch_saves_nothing(tmp_path, monkeypatch):
     )
     t = Trainer(cfg)
 
-    def interrupting(epoch, start_step=0):
+    def interrupting(epoch, start_step=0, start_examples=0):
         raise KeyboardInterrupt
 
     monkeypatch.setattr(t, "train_epoch", interrupting)
@@ -104,7 +104,7 @@ def test_interrupt_mid_epoch_keeps_clean_boundary_ckpt(tmp_path, monkeypatch):
     ckpt0 = os.path.join(str(tmp_path), "ckpt_0.npz")
     clean_mtime = {}
 
-    def interrupting(epoch, start_step=0):
+    def interrupting(epoch, start_step=0, start_examples=0):
         calls["n"] += 1
         if calls["n"] == 2:
             # clean ckpt_0 exists now (save_every=1); record its mtime

@@ -151,7 +151,7 @@ def test_reinterrupt_before_first_resumed_step_keeps_exact_position(
     # epoch boundary (reviewer finding r5)
     t3 = Trainer(cfg.replace(resume=True))
 
-    def preamble_interrupt(epoch, start_step=0):
+    def preamble_interrupt(epoch, start_step=0, start_examples=0):
         raise KeyboardInterrupt
 
     monkeypatch.setattr(t3, "train_epoch", preamble_interrupt)

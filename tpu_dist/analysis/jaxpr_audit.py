@@ -958,14 +958,139 @@ def live_export_noop_violations(mesh=None) -> list[Violation]:
     return out
 
 
+def elastic_resume_noop_violations(mesh=None) -> list[Violation]:
+    """TD111: elastic resume must be invisible to the compiled program —
+    a trainer whose state was RESTORED from a checkpoint written at a
+    different dp extent (and remapped by ``tpu_dist/elastic/remap.py``)
+    must trace the byte-identical step a fresh-start trainer at the same
+    (new) world size traces.
+
+    The probe builds the old world's ZeRO-1 + error-feedback state
+    host-side (momentum padded for ``n_old`` devices, ``n_old`` residual
+    rows), saves a real checkpoint, restores it through the elastic
+    remapper onto a template laid out for ``n_new = n_old // 2`` devices,
+    and traces the ``n_new`` train step with the fresh state and with the
+    restored one. Any remap sloppiness — a float64 leak from numpy
+    padding, a wrong flat length, a dtype drift — changes the avals and
+    trips this; and the probe asserts the remapper actually FIRED when
+    the two extents produce different padded lengths (a vacuous
+    comparison is itself a violation). The probe model's raveled length
+    is congruent to 4 mod 8 precisely so the 8-to-4 shrink changes the
+    padded layouts (the default audit MLP's 480 divides every mesh
+    width, which would make the remap a no-op)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_dist.ckpt import checkpoint as ckpt_lib
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.comm.quantize import padded_len
+    from tpu_dist.elastic.remap import Remapper, params_len
+    from tpu_dist.train.optim import SGD
+    from tpu_dist.train.state import TrainState
+    from tpu_dist.train.step import (
+        ef_state_host_zeros,
+        init_ef_state,
+        init_sharded_opt_state,
+        make_train_step,
+    )
+
+    devs = (
+        list(mesh.devices.ravel()) if mesh is not None else jax.devices()
+    )
+    n_old = len(devs)
+    n_new = max(1, n_old // 2)
+    mesh_new = mesh_lib.data_parallel_mesh(devs[:n_new])
+
+    class _ElasticMLP(_AuditMLP):
+        # classes=12 → L = 12*16 + 16 + 16*12 + 12 = 412 ≡ 4 (mod 8):
+        # padded_len(412, 8) = 416 != 412 = padded_len(412, 4) — the
+        # shrink genuinely reshapes the flat layouts
+        classes = 12
+
+    model = _ElasticMLP()
+    params, bn = model.init(jax.random.PRNGKey(0))
+    L = params_len(params)
+    params_host = jax.tree_util.tree_map(np.asarray, params)
+    mom_old = np.zeros((padded_len(L, n_old),), np.float32)
+    mom_old[:L] = np.arange(L, dtype=np.float32) * 1e-3
+    ef_old = ef_state_host_zeros(params_host, n_old, zero1=True)
+    ef_old = {
+        "r1": (np.arange(ef_old["r1"].size) * 1e-6).astype(np.float32)
+    }
+    st_old = TrainState(
+        params_host, {}, mom_old, np.asarray(0, np.int32), ef_old
+    )
+    tmp = tempfile.mkdtemp(prefix="td111_elastic_")
+    out: list[Violation] = []
+    try:
+        path = ckpt_lib.save(tmp, st_old, epoch=0)
+        opt = SGD(momentum=0.9, weight_decay=1e-4)
+        state_new = TrainState(
+            params, bn,
+            init_sharded_opt_state(params, mesh_new),
+            jnp.zeros((), jnp.int32),
+            init_ef_state(params, mesh_new, zero1=True),
+        )
+        step = make_train_step(
+            model.apply, opt, mesh_new, sync_bn=False,
+            shard_weight_update=True, grad_compression="int8_ef",
+        )
+        b = 8 * n_new
+        images = jax.ShapeDtypeStruct((b, 2, 2, 3), jnp.float32)
+        labels = jax.ShapeDtypeStruct((b,), jnp.int32)
+        lr = jax.ShapeDtypeStruct((), jnp.float32)
+        base = str(jax.make_jaxpr(step)(state_new, images, labels, lr))
+        remapper = Remapper(L, n_new, n_old=n_old)
+        restored = ckpt_lib.restore(path, state_new, remap=remapper)
+        resumed = str(jax.make_jaxpr(step)(restored, images, labels, lr))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    layouts_differ = (
+        padded_len(L, n_old) != padded_len(L, n_new) or n_old != n_new
+    )
+    if layouts_differ and not remapper.used:
+        out.append(
+            Violation(
+                "TD111",
+                "<jaxpr:dp_elastic_resume_noop>",
+                0,
+                "the TD111 probe restored across different dp extents but "
+                "the elastic remapper never fired — the armed-vs-fresh "
+                "comparison would be vacuous; the restore path stopped "
+                "routing shape mismatches through the remap hook",
+                snippet="elastic remapper did not fire",
+            )
+        )
+    if base != resumed:
+        out.append(
+            Violation(
+                "TD111",
+                "<jaxpr:dp_elastic_resume_noop>",
+                0,
+                "the traced train step of an elastic-resumed trainer "
+                "differs from a fresh-start trainer at the same (new) "
+                "world size — the checkpoint remap leaked into the "
+                "compiled program (shape/dtype drift in the remapped "
+                "ZeRO-1/EF flat layouts; tpu_dist/elastic/remap.py "
+                "contract)",
+                snippet="jaxpr(fresh_start) != jaxpr(elastic_resumed)",
+            )
+        )
+    return out
+
+
 def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     """Run every (or the named) registered case. Returns
     ``(report, violations)`` where report maps case → op counts.
     Cross-case TD104 wire-ratio checks run over whichever quantized/
     reference pairs the report contains; full (unfiltered) runs also check
     the TD105 fault-injection, TD106 telemetry, TD107 device-metrics,
-    TD108 profiler-trigger, TD109 live-export/alerting, and TD110
-    capture-auto-analyze no-op invariants."""
+    TD108 profiler-trigger, TD109 live-export/alerting, TD110
+    capture-auto-analyze, and TD111 elastic-resume no-op invariants."""
     report: dict = {}
     violations: list[Violation] = []
     for name in names if names is not None else registered_cases():
@@ -991,6 +1116,9 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
         violations.extend(vs)
         vs = xprof_hook_noop_violations(mesh)
         report["dp_xprof_hook_noop"] = {"identical": not vs}
+        violations.extend(vs)
+        vs = elastic_resume_noop_violations(mesh)
+        report["dp_elastic_resume_noop"] = {"identical": not vs}
         violations.extend(vs)
     return report, violations
 

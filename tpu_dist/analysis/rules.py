@@ -157,6 +157,16 @@ RULES: dict[str, Rule] = {
             "host-side file crunching; obs/profile.py contract)",
         ),
         Rule(
+            "TD111",
+            "elastic-resume-not-noop",
+            "the traced train step of an elastic-resumed trainer (state "
+            "restored from a checkpoint written at a DIFFERENT dp extent "
+            "and remapped) differs from a fresh-start trainer at the same "
+            "new world size — the remap must be restore-time host work "
+            "that reproduces exactly the shapes/dtypes a fresh "
+            "construction gets (tpu_dist/elastic/remap.py contract)",
+        ),
+        Rule(
             "TD104",
             "quantized-wire-bytes-over-budget",
             "gradient-collective payload bytes of a quantized wire format "

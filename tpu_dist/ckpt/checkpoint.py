@@ -23,6 +23,7 @@ from typing import Any, List, Optional, Tuple
 import jax
 import numpy as np
 
+from tpu_dist.elastic.errors import ConfigMismatchError, ElasticShapeMismatch
 from tpu_dist.obs import counters, spans
 from tpu_dist.resilience import faults
 from tpu_dist.resilience import retry as retry_lib
@@ -110,7 +111,38 @@ def _missing_ok(key: str, leaf) -> Optional[np.ndarray]:
     return None
 
 
-def _unflatten(template, flat: dict):
+def _resolve_shape_mismatch(remap, key: str, arr: np.ndarray, leaf, template):
+    """A checkpoint entry's shape disagrees with the template: apply the
+    elastic ``remap`` hook (the trainer's restore ladder always supplies
+    one — docs/resilience.md "Elastic training"), or raise the typed
+    error: :class:`ElasticShapeMismatch` for a dp-extent-dependent leaf
+    saved at a different world size (benign — retry with a remapper),
+    :class:`ConfigMismatchError` for everything else (real config drift,
+    which must never be silently resumed past)."""
+    if remap is not None:
+        out = remap(key, arr, leaf)
+        if out is not None:
+            if tuple(np.shape(out)) != tuple(np.shape(leaf)):
+                raise ConfigMismatchError(
+                    f"elastic remap of {key} produced shape "
+                    f"{tuple(np.shape(out))}, template wants "
+                    f"{tuple(np.shape(leaf))} — remapper/template "
+                    "disagreement"
+                )
+            return out
+    from tpu_dist.elastic.remap import classify, params_len  # noqa: PLC0415
+
+    L = params_len(template.get("params", {})) if isinstance(template, dict) else 0
+    want = tuple(np.shape(leaf))
+    got = tuple(np.shape(arr))
+    if L and classify(key, got, want, L) is not None:
+        raise ElasticShapeMismatch(key, got, want)
+    raise ConfigMismatchError(
+        f"shape mismatch for {key}: ckpt {got} vs state {want}"
+    )
+
+
+def _unflatten(template, flat: dict, remap=None):
     paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
     for path, leaf in paths_leaves:
@@ -123,7 +155,7 @@ def _unflatten(template, flat: dict):
             raise KeyError(f"checkpoint missing array for {key}")
         arr = flat[key]
         if tuple(arr.shape) != tuple(leaf.shape):
-            raise ValueError(f"shape mismatch for {key}: ckpt {arr.shape} vs state {leaf.shape}")
+            arr = _resolve_shape_mismatch(remap, key, arr, leaf, template)
         leaves.append(arr.astype(leaf.dtype))
     return jax.tree_util.tree_unflatten(treedef, [l for l in leaves])
 
@@ -435,7 +467,9 @@ def read_meta(path: str) -> dict:
         return json.loads(bytes(z["__meta__"].tobytes()).decode())
 
 
-def restore(path: str, template: TrainState, verify: bool = False) -> TrainState:
+def restore(
+    path: str, template: TrainState, verify: bool = False, remap=None
+) -> TrainState:
     """Rebuild a TrainState shaped like ``template`` from ``path``.
 
     Arrays come back as host numpy; the caller re-places them on the mesh
@@ -443,6 +477,10 @@ def restore(path: str, template: TrainState, verify: bool = False) -> TrainState
     entry against its ``__meta__`` stamp AS IT IS READ — same coverage as
     :func:`verify_npz` in the single decompression pass the restore does
     anyway (a separate verify-then-restore would read the archive twice).
+    ``remap`` is the elastic shape-mismatch hook (``tpu_dist/elastic/
+    remap.py``): entries whose shape bakes in a different data-parallel
+    extent are rebuilt for this run's extent instead of raising — without
+    it, such entries raise the typed :class:`ElasticShapeMismatch`.
     """
     with spans.span("ckpt/restore", file=os.path.basename(path)), np.load(path) as z:
         crcs = None
@@ -475,7 +513,7 @@ def restore(path: str, template: TrainState, verify: bool = False) -> TrainState
                         "corruption"
                     )
             flat[k] = arr
-    d: Any = _unflatten(template._asdict(), flat)
+    d: Any = _unflatten(template._asdict(), flat, remap=remap)
     return TrainState(**d)
 
 
@@ -770,12 +808,27 @@ def read_sharded_meta(manifest_path: str) -> dict:
         return json.load(f)["meta"]
 
 
-def restore_sharded(manifest_path: str, template: TrainState) -> TrainState:
+def restore_sharded(
+    manifest_path: str, template: TrainState, remap=None
+) -> TrainState:
     """Rebuild a TrainState shaped (and PLACED) like ``template``.
 
     Overlap-only reads: each process decompresses just the pieces that
     intersect its own target shards, so restore memory scales with the
-    local partition, not the global model (see the section header)."""
+    local partition, not the global model (see the section header).
+
+    The manifest's per-entry global shapes + each shard key's slice
+    origin/extent make the format mesh-shape-portable: a checkpoint
+    written by ``n`` processes restores onto any other process count or
+    device sharding by overlap reslice alone whenever the leaf's GLOBAL
+    shape is world-size-independent (params, BN, per-leaf momentum).
+    Leaves whose global shape bakes in the dp extent (ZeRO-1 flat
+    optimizer vectors, error-feedback residuals) go through ``remap``
+    (``tpu_dist/elastic/remap.py``): the full checkpoint-global value is
+    assembled from its pieces — the allgather-then-reslice fallback —
+    remapped to this run's extent, then sliced onto the template's
+    shards. Without a hook such leaves raise the typed
+    :class:`ElasticShapeMismatch`."""
     # (span: the trainer's restore ladder wraps this whole call — a local
     # span here would cover only the manifest read)
     with open(manifest_path) as f:
@@ -843,9 +896,8 @@ def restore_sharded(manifest_path: str, template: TrainState) -> TrainState:
             )
         return buf
 
-    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(
-        template._asdict()
-    )
+    tdict = template._asdict()
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(tdict)
     out = []
     try:
         for path, leaf in paths_leaves:
@@ -872,10 +924,32 @@ def restore_sharded(manifest_path: str, template: TrainState) -> TrainState:
                 leaf.dtype if hasattr(leaf, "dtype") else np.asarray(leaf).dtype
             )
             if tuple(np.shape(leaf)) != gshape:
-                raise ValueError(
-                    f"shape mismatch for {key}: ckpt {gshape} vs state "
-                    f"{np.shape(leaf)}"
+                # dp-extent-dependent leaf saved at another world size:
+                # assemble the FULL checkpoint-global value from its
+                # pieces (the allgather-then-reslice fallback — these are
+                # flat vectors, not the bulk params) and run the elastic
+                # hook; _resolve raises the typed error without one
+                full = assemble(key, (0,) * len(gshape), gshape, dtype)
+                remapped = np.asarray(
+                    _resolve_shape_mismatch(remap, key, full, leaf, tdict)
+                ).astype(dtype)
+                if not isinstance(leaf, jax.Array):
+                    out.append(
+                        remapped if np.shape(remapped) else remapped[()]
+                    )
+                    continue
+                parts = [
+                    jax.device_put(
+                        np.ascontiguousarray(remapped[sh.index]), sh.device
+                    )
+                    for sh in leaf.addressable_shards
+                ]
+                out.append(
+                    jax.make_array_from_single_device_arrays(
+                        tuple(np.shape(leaf)), leaf.sharding, parts
+                    )
                 )
+                continue
             if not isinstance(leaf, jax.Array):
                 full = assemble(key, (0,) * len(gshape), gshape, dtype)
                 out.append(full if gshape else full[()])

@@ -53,6 +53,21 @@ stall — the watchdog cannot tell a wedged step from one that never beat.
 Once a preemption shutdown begins the watchdog stands down: children beat
 once ('preempted') then go silent in the emergency save by design, and
 reclassifying that as a wedge would turn the requeue-75 exit into a crash.
+
+Elastic contract (docs/resilience.md "Elastic training"): with
+``--elastic_min_procs`` set, the launcher becomes its own orchestrator for
+the shrink case. A round that ends preempted (exit 75) or with dead ranks
+is not the end of the run: the supervisor (``tpu_dist/elastic/
+supervisor.py``) counts which ranks survived (clean / 75 / forwarded-
+SIGTERM exits), picks the largest feasible reduced world size (a divisor
+of the original ``--nproc``, at least the floor), waits the deterministic
+backoff, and relaunches the command with ``--resume`` injected and
+``TPU_DIST_ELASTIC_RESTARTS`` in the environment — the trainer's elastic
+restore ladder remaps the checkpoint onto the new dp extent and the
+sampler re-partitions the remaining examples. Bounded by
+``--elastic_max_restarts``. A SIGTERM to the LAUNCHER itself still means
+"the orchestrator wants the job gone": elastic stands down and the
+distinct requeue-75 code propagates as before.
 """
 
 from __future__ import annotations
@@ -66,6 +81,7 @@ import sys
 import time
 from typing import Dict, List, Optional, Sequence
 
+from tpu_dist.elastic.supervisor import RoundResult, supervise
 from tpu_dist.resilience.preemption import PREEMPTION_EXIT_CODE
 
 
@@ -83,6 +99,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument(
         "--devices_per_proc", type=int, default=0,
         help=">0: give each process N emulated CPU devices (testing mode)",
+    )
+    p.add_argument(
+        "--elastic_min_procs", type=int, default=0, metavar="N",
+        help="enable the elastic supervisor: when a round ends preempted "
+             "(exit 75) or with dead ranks, relaunch --resume at the "
+             "largest feasible reduced world size (a divisor of --nproc) "
+             "instead of failing the run, never below N; 0 (default) "
+             "disables — one round, exit codes as before",
+    )
+    p.add_argument(
+        "--elastic_max_restarts", type=int, default=3, metavar="K",
+        help="elastic relaunch budget: give up (surfacing the real exit "
+             "code) after K relaunches — a deterministic crash loop must "
+             "not cycle forever",
+    )
+    p.add_argument(
+        "--elastic_backoff", type=float, default=0.5, metavar="S",
+        help="base of the deterministic exponential backoff between "
+             "elastic relaunches (resilience/retry.py schedule: "
+             "S * 2^restart, capped at 30s)",
     )
     p.add_argument(
         "--heartbeat_dir", default=None,
@@ -120,7 +156,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.watchdog_timeout > 0 and not args.heartbeat_dir:
         p.error("--watchdog_timeout needs --heartbeat_dir (the liveness "
                 "signal it watches)")
-    port = args.port or _free_port()
+    if args.elastic_min_procs > args.nproc:
+        p.error(f"--elastic_min_procs {args.elastic_min_procs} exceeds "
+                f"--nproc {args.nproc}")
 
     hb_base = None
     if args.heartbeat_dir:
@@ -136,16 +174,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # .h<k> textfiles and the watchdog scrapes them back
         metrics_base = os.path.join(args.metrics_dir, "metrics.prom")
 
-    procs: List[subprocess.Popen] = []
-    ranks: Dict[subprocess.Popen, int] = {}
-    preempted = [False]
+    live: List[subprocess.Popen] = []  # the CURRENT round's children
+    launcher_sig = [False]  # SIGTERM delivered to the LAUNCHER itself
 
     def _forward_sigterm(signum, frame):  # noqa: ARG001
         # graceful fan-out: children run their own SIGTERM discipline
         # (emergency snapshot + distinct exit code); we keep waiting for
-        # them below instead of dying and orphaning the job
-        preempted[0] = True
-        for pr in list(procs):
+        # them below instead of dying and orphaning the job. This is also
+        # the elastic stand-down signal: the orchestrator preempting the
+        # whole job outranks any local relaunch policy.
+        launcher_sig[0] = True
+        for pr in list(live):
             try:
                 pr.send_signal(signal.SIGTERM)
             except OSError:  # tpu-dist: ignore[TD006] — child already gone
@@ -156,7 +195,60 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     except ValueError:  # not the main thread (embedded use) — skip
         prev_term = None
     try:
-        for rank in range(args.nproc):
+        def round_fn(nproc: int, restart: int) -> RoundResult:
+            return _run_round(
+                args, cmd, nproc, restart, hb_base, metrics_base,
+                live, launcher_sig,
+            )
+
+        if args.elastic_min_procs <= 0:
+            return round_fn(args.nproc, 0).rc
+
+        def say(msg: str) -> None:
+            # tpu-dist: ignore[TD002,TD007] — the launcher IS the single
+            # parent process and stderr is its orchestrator contract
+            print(f"launch: {msg}", file=sys.stderr, flush=True)
+
+        return supervise(
+            round_fn,
+            nproc=args.nproc,
+            min_procs=args.elastic_min_procs,
+            max_restarts=args.elastic_max_restarts,
+            backoff_base=args.elastic_backoff,
+            announce=say,
+            should_continue=lambda: not launcher_sig[0],
+        )
+    finally:
+        if prev_term is not None:
+            signal.signal(signal.SIGTERM, prev_term)
+        for pr in live:
+            pr.kill()
+
+
+def _run_round(
+    args,
+    cmd: List[str],
+    nproc: int,
+    restart: int,
+    hb_base: Optional[str],
+    metrics_base: Optional[str],
+    live: List[subprocess.Popen],
+    launcher_sig: List[bool],
+) -> RoundResult:
+    """Spawn and supervise ONE world: ``nproc`` children at a fresh
+    coordinator port, fail-fast + watchdog + preemption semantics exactly
+    as the single-round launcher always had. Returns the aggregate exit
+    code plus every rank's raw exit status — the elastic supervisor's
+    survivor census. ``live`` is the launcher-level registry the SIGTERM
+    handler forwards to (children of the current round only)."""
+    port = args.port or _free_port()
+    procs: List[subprocess.Popen] = []
+    ranks: Dict[subprocess.Popen, int] = {}
+    exits: Dict[int, int] = {}
+    preempted = [launcher_sig[0]]  # a child's exit-75 also sets this
+
+    try:
+        for rank in range(nproc):
             env = dict(os.environ)
             if args.devices_per_proc > 0:
                 env["PALLAS_AXON_POOL_IPS"] = ""  # CPU testing mode
@@ -165,18 +257,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     env.get("XLA_FLAGS", "")
                     + f" --xla_force_host_platform_device_count={args.devices_per_proc}"
                 ).strip()
+            # relaunched rounds tell the trainer which restart they are
+            # (elastic.restarts gauge); round 0 stamps 0 so a child's env
+            # never inherits a stale value from the launcher's own env
+            env["TPU_DIST_ELASTIC_RESTARTS"] = str(restart)
             child = cmd + [
-                "--num_processes", str(args.nproc),
+                "--num_processes", str(nproc),
                 "--process_id", str(rank),
                 "--ip", args.ip,
                 "--port", str(port),
             ]
+            if restart > 0 and "--resume" not in cmd:
+                # the relaunched world must continue the run, not restart
+                # it — the trainer's elastic restore ladder picks up the
+                # emergency/periodic checkpoint and remaps onto the new
+                # dp extent
+                child.append("--resume")
             if hb_base is not None:
                 child += ["--heartbeat_file", hb_base]
             if metrics_base is not None:
                 child += ["--metrics_file", metrics_base]
             pr = subprocess.Popen(child, env=env)
             procs.append(pr)
+            live.append(pr)
             ranks[pr] = rank
 
         rc = 0
@@ -238,7 +341,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             nonlocal crash_rc
             from tpu_dist.obs import heartbeat as heartbeat_lib  # noqa: PLC0415
 
-            if preempted[0]:
+            if preempted[0] or launcher_sig[0]:
                 # preemption shutdown: each child beats once ('preempted')
                 # then goes silent in its emergency save BY DESIGN — a
                 # frozen counter here is not a wedge, and reclassifying it
@@ -284,42 +387,47 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             except OSError:  # tpu-dist: ignore[TD006] — child already gone
                 pass
 
-        while procs:
-            for pr in list(procs):
+        pending = list(procs)
+        while pending:
+            for pr in list(pending):
                 ret = pr.poll()
                 if ret is None:
                     if watchdog:
                         _watch(pr)
                     continue
-                procs.remove(pr)
+                pending.remove(pr)
+                exits[ranks[pr]] = ret
                 if ret == PREEMPTION_EXIT_CODE:
                     preempted[0] = True
                 elif ret not in (0, -signal.SIGTERM) and crash_rc == 0:
                     crash_rc = ret
                 if ret != 0 and rc == 0:
                     rc = ret
-                    for other in procs:  # fail fast like torchrun — which,
+                    for other in pending:  # fail fast like torchrun — which,
                         # with the trainer's cooperative handler installed,
                         # is a GRACEFUL shutdown request, not a kill
                         other.send_signal(signal.SIGTERM)
-            if procs:
+            if pending:
                 try:
-                    procs[0].wait(timeout=1)
+                    pending[0].wait(timeout=1)
                 except subprocess.TimeoutExpired:
                     pass
         if crash_rc:
-            return crash_rc  # a crash/wedge outranks a concurrent preemption
-        if preempted[0] and rc in (0, PREEMPTION_EXIT_CODE, -signal.SIGTERM):
+            # a crash/wedge outranks a concurrent preemption
+            return RoundResult(crash_rc, exits)
+        if (preempted[0] or launcher_sig[0]) and rc in (
+            0, PREEMPTION_EXIT_CODE, -signal.SIGTERM
+        ):
             # the whole job was preempted (not crashed): surface the
             # distinct requeue-me code even if some child died on the raw
             # signal before its handler was installed
-            return PREEMPTION_EXIT_CODE
-        return rc
+            return RoundResult(PREEMPTION_EXIT_CODE, exits)
+        return RoundResult(rc, exits)
     finally:
-        if prev_term is not None:
-            signal.signal(signal.SIGTERM, prev_term)
         for pr in procs:
-            pr.kill()
+            pr.kill()  # no-op on already-reaped children
+            if pr in live:
+                live.remove(pr)
 
 
 if __name__ == "__main__":

@@ -40,16 +40,47 @@ class DistributedSampler:
         self.seed = seed
         self.drop_last = drop_last
         self.epoch = 0
+        self.offset = 0  # consumed-prefix skip (elastic mid-epoch resume)
+        self._recompute_sizes()
 
-        if drop_last:
-            self.num_samples = num_examples // num_shards
+    def _recompute_sizes(self) -> None:
+        remaining = self.num_examples - self.offset
+        if self.drop_last:
+            self.num_samples = remaining // self.num_shards
         else:
-            self.num_samples = -(-num_examples // num_shards)  # ceil
-        self.total_size = self.num_samples * num_shards
+            self.num_samples = -(-remaining // self.num_shards)  # ceil
+        self.total_size = self.num_samples * self.num_shards
 
     def set_epoch(self, epoch: int) -> None:
-        """Reference ``train_sampler.set_epoch(epoch)`` (``distributed.py:81``)."""
+        """Reference ``train_sampler.set_epoch(epoch)`` (``distributed.py:81``).
+        Also clears any mid-epoch offset — the skip applies to the resumed
+        epoch only; the next epoch partitions the full permutation again."""
         self.epoch = epoch
+        if self.offset:
+            self.set_offset(0)
+
+    def set_offset(self, n_examples: int) -> None:
+        """Skip the first ``n_examples`` of the current epoch's GLOBAL
+        order and re-partition the remainder over the shards — the elastic
+        mid-epoch-resume entry point (docs/resilience.md).
+
+        Why this is exact: shards advance in lockstep (steps are
+        synchronous), so after ``k`` global batches every shard has
+        consumed the first ``k * local_batch`` elements of its strided
+        stream — and the union of those per-shard prefixes is precisely
+        the first ``k * global_batch`` elements of the epoch permutation.
+        Resuming with ``offset = k * global_batch`` therefore hands out
+        exactly the not-yet-seen examples, no matter how many shards the
+        OLD run had: nothing is dropped, nothing is double-seen. (For the
+        same shard count, ``order[C:][j::n] == order[j::n][C//n:]`` since
+        the global batch divides over the shards — the offset path
+        strictly generalizes ``DataLoader.iter_from``.)"""
+        if not 0 <= n_examples <= self.num_examples:
+            raise ValueError(
+                f"offset {n_examples} outside [0, {self.num_examples}]"
+            )
+        self.offset = int(n_examples)
+        self._recompute_sizes()
 
     def indices(self) -> np.ndarray:
         """This shard's indices for the current epoch (deterministic)."""
@@ -58,9 +89,11 @@ class DistributedSampler:
             order = g.permutation(self.num_examples)
         else:
             order = np.arange(self.num_examples)
+        if self.offset:
+            order = order[self.offset :]
         if self.drop_last:
             order = order[: self.total_size]
-        elif len(order) < self.total_size:
+        elif 0 < len(order) < self.total_size:
             # wrap-around padding, same policy as torch's sampler; tile so
             # even num_shards > num_examples pads fully
             reps = -(-self.total_size // len(order))
@@ -73,9 +106,10 @@ class DistributedSampler:
         if self.drop_last:
             return np.ones(self.num_samples, dtype=bool)
         # Padding occupies the tail of the padded global order regardless of
-        # shuffle (the permutation covers only the first num_examples slots).
+        # shuffle (the permutation covers only the first num_examples slots
+        # past the consumed offset).
         positions = np.arange(self.shard_id, self.total_size, self.num_shards)
-        return positions < self.num_examples
+        return positions < self.num_examples - self.offset
 
     def __len__(self) -> int:
         return self.num_samples

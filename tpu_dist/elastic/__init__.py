@@ -1,0 +1,42 @@
+"""Elastic training: mesh-shape-portable checkpoints and mid-run shrink.
+
+Three pieces (docs/resilience.md "Elastic training"):
+
+* ``elastic/remap.py`` — restore a checkpoint onto a different
+  data-parallel extent: world-size-independent leaves re-slice, the
+  dp-dependent flat layouts (ZeRO-1 optimizer vectors, error-feedback
+  residuals) are remapped bit-exactly where dtype allows.
+* ``elastic/supervisor.py`` — the launcher's relaunch policy: when a
+  preemption or rank death ends a round, relaunch ``--resume`` at the
+  largest feasible reduced world size instead of failing the run.
+* ``elastic/drill.py`` — the local proof: preempt a run mid-epoch,
+  resume shrunken, assert state bit-identity and loss-trajectory parity
+  (``make elastic-drill``).
+"""
+
+from tpu_dist.elastic.errors import ConfigMismatchError, ElasticShapeMismatch
+from tpu_dist.elastic.remap import (
+    Remapper,
+    classify,
+    elastic_stamp,
+    make_remapper,
+    params_len,
+)
+from tpu_dist.elastic.supervisor import (
+    RoundResult,
+    next_world_size,
+    supervise,
+)
+
+__all__ = [
+    "ConfigMismatchError",
+    "ElasticShapeMismatch",
+    "Remapper",
+    "RoundResult",
+    "classify",
+    "elastic_stamp",
+    "make_remapper",
+    "next_world_size",
+    "params_len",
+    "supervise",
+]

@@ -1,0 +1,50 @@
+"""Typed restore-ladder errors for elastic training (docs/resilience.md).
+
+Before elastic resume existed, every mismatch between a checkpoint and the
+live run raised a generic ``ValueError`` — so a world-size change (which
+only reshapes the dp-extent-dependent leaves: ZeRO-1 flat optimizer
+vectors, error-feedback residuals) pattern-matched to config drift and
+bricked the resume. The split:
+
+* :class:`ElasticShapeMismatch` — **benign**: the shape difference is
+  exactly the one a different data-parallel extent produces on an
+  elastic-remappable leaf. The restore ladder handles it by re-running the
+  restore with a :class:`tpu_dist.elastic.remap.Remapper`.
+* :class:`ConfigMismatchError` — **operator error**: a layout stamp
+  (pipeline interleave, AdamW decay mask, mid-epoch data-position pins) or
+  a parameter-shape mismatch that no world-size change explains. Still
+  raises — falling past it would silently resume the wrong run.
+
+Both subclass ``ValueError`` so pre-elastic callers (and tests) that catch
+the generic type keep working. This module imports nothing — it sits below
+both ``tpu_dist.ckpt`` and ``tpu_dist.elastic.remap`` in the import graph.
+"""
+
+from __future__ import annotations
+
+
+class ConfigMismatchError(ValueError):
+    """The checkpoint disagrees with the live run in a way that is NOT a
+    world-size change (model shape drift, layout stamps, data-position
+    pins). The restore ladder re-raises: resuming past it would silently
+    train the wrong run."""
+
+
+class ElasticShapeMismatch(ValueError):
+    """A leaf's checkpointed shape differs from the template only because
+    the run's data-parallel extent changed — the elastic remapper
+    (``tpu_dist/elastic/remap.py``) can rebuild it exactly. Raised by the
+    checkpoint layer when no remap hook was supplied; the trainer's
+    restore ladder catches the *class* of problem up front by always
+    restoring with a remapper."""
+
+    def __init__(self, key: str, ckpt_shape, want_shape):
+        self.key = key
+        self.ckpt_shape = tuple(ckpt_shape)
+        self.want_shape = tuple(want_shape)
+        super().__init__(
+            f"elastic shape mismatch for {key}: ckpt {self.ckpt_shape} vs "
+            f"state {self.want_shape} — a dp-extent-dependent leaf saved at "
+            "a different world size; restore with an elastic remapper "
+            "(docs/resilience.md 'Elastic training')"
+        )

@@ -1,0 +1,223 @@
+"""Mesh-shape-portable checkpoint remapping (docs/resilience.md).
+
+A checkpoint's parameters, BN statistics and per-leaf momentum are
+world-size-independent: their global shapes never mention the mesh, so a
+resume onto a different data-parallel extent only needs a re-slice (which
+``restore_sharded`` already does from the manifest's shard-piece origins).
+Three leaf families are NOT: their *global* shapes bake in the dp extent
+``n`` because they are flat vectors padded to an ``n``-divisible length
+(``comm/quantize.py::padded_len``):
+
+========================  =====================  ==========================
+leaf                      global shape at dp=n   logical content
+========================  =====================  ==========================
+ZeRO-1 flat opt state     ``(ceil(L/n)*n,)``     first ``L`` entries (the
+(SGD momentum, AdamW                             raveled param order); the
+mu/nu)                                           pad tail is provably zero
+                                                 (pad grads are zero, decay
+                                                 intervals stop at ``L``)
+``ef['r1']`` residuals    ``(n*P,)``,            row ``i`` = replica i's
+                          ``P=padded_len(L,n)``  send-side quantization
+                                                 error over the padded
+                                                 gradient
+``ef['r2']`` residuals    ``(P,)``               per-coordinate leg-2 error
+                                                 of the reduced gradient
+========================  =====================  ==========================
+
+Remap contract (what is bit-exact vs parity-only):
+
+* **ZeRO-1 flat opt state — bit-exact.** The logical ``[:L]`` prefix is
+  copied verbatim (dtype preserved); both tails are zeros. A nonzero
+  source tail means the layout assumption broke and raises loudly.
+* **``r2`` — bit-exact per coordinate.** It is positional over the reduced
+  gradient: crop to ``L``, re-pad with zeros. Residuals beyond ``L`` chase
+  pad coordinates that are sliced off before they ever touch a parameter.
+* **``r1`` — aggregate-exact, per-replica parity.** What matters to the
+  next update is the SUM over replicas (each replica adds its row to its
+  gradient contribution before the reduce), so the remap folds the old
+  rows' ``[:L]`` columns into new replica 0's row and zeroes the rest:
+  the total compensated error is preserved to the bit, while the
+  per-replica split (which only shapes the next step's quantization
+  ranges) is not — error feedback re-balances itself within one step.
+
+The remapper is a host-side hook the checkpoint layer calls on a shape
+mismatch (``restore(..., remap=...)`` / ``restore_sharded(..., remap=...)``)
+— nothing here touches jax, so an elastic-resumed trainer's traced step is
+byte-identical to a fresh start at the new world size (jaxpr rule TD111).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from tpu_dist.elastic.errors import ConfigMismatchError, ElasticShapeMismatch
+
+__all__ = [
+    "ConfigMismatchError",
+    "ElasticShapeMismatch",
+    "Remapper",
+    "classify",
+    "elastic_stamp",
+    "make_remapper",
+    "params_len",
+]
+
+_EF_R1_PREFIX = "['ef']['r1']"
+_EF_R2_PREFIX = "['ef']['r2']"
+_OPT_PREFIX = "['opt_state']"
+
+
+def params_len(params) -> int:
+    """Logical length ``L`` of the raveled parameter vector — the one
+    world-size-independent coordinate every elastic flat layout is padded
+    from. Pure shape arithmetic (no device math, works on numpy and
+    jax.Array leaves alike)."""
+    import jax  # noqa: PLC0415 — shape walking only
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        shape = np.shape(leaf)
+        total += int(np.prod(shape)) if shape else 1
+    return total
+
+
+def elastic_stamp(n_data: int, procs: int, L: int) -> dict:
+    """The ``elastic`` checkpoint-meta stamp: the dp extent the state was
+    laid out for, the process count (the sampler's shard count), and the
+    logical param length — everything a restore at a different world size
+    needs to remap deterministically."""
+    return {"dp": int(n_data), "procs": int(procs), "params_len": int(L)}
+
+
+def classify(
+    key: str, ckpt_shape: Tuple[int, ...], want_shape: Tuple[int, ...], L: int
+) -> Optional[str]:
+    """Which elastic family (if any) explains a ``ckpt_shape`` vs
+    ``want_shape`` mismatch on ``key``: ``'zero1_flat'`` / ``'ef_r1'`` /
+    ``'ef_r2'``, or None (a real config mismatch)."""
+    if key.startswith(_EF_R1_PREFIX):
+        return "ef_r1"
+    if key.startswith(_EF_R2_PREFIX):
+        return "ef_r2"
+    if (
+        key.startswith(_OPT_PREFIX)
+        and len(ckpt_shape) == 1
+        and len(want_shape) == 1
+        and ckpt_shape[0] >= L
+        and want_shape[0] >= L
+    ):
+        return "zero1_flat"
+    return None
+
+
+class Remapper:
+    """Shape-mismatch hook for ``ckpt.restore``/``restore_sharded``:
+    rebuilds the dp-extent-dependent leaves at the new extent (module
+    docstring for the exactness contract). ``used`` records every
+    ``(key, kind)`` it actually remapped, so the trainer can tell a
+    resharded resume (counter + rank-0 line) from a same-shape one — and
+    the TD111 probe can prove it fired."""
+
+    def __init__(self, L: int, n_new: int, n_old: Optional[int] = None):
+        if L <= 0:
+            raise ValueError(f"params_len must be positive, got {L}")
+        if n_new <= 0:
+            raise ValueError(f"n_new must be positive, got {n_new}")
+        self.L = int(L)
+        self.n_new = int(n_new)
+        self.n_old = int(n_old) if n_old is not None else None
+        self.used: list = []
+
+    def __call__(self, key: str, arr: np.ndarray, leaf) -> Optional[np.ndarray]:
+        want = tuple(np.shape(leaf))
+        arr = np.asarray(arr)
+        kind = classify(key, tuple(arr.shape), want, self.L)
+        if kind is None:
+            return None
+        out = getattr(self, f"_remap_{kind}")(key, arr.ravel(), int(np.prod(want)))
+        self.used.append((key, kind))
+        return out.reshape(want)
+
+    # -- families ----------------------------------------------------------
+
+    def _remap_zero1_flat(self, key: str, arr: np.ndarray, want: int) -> np.ndarray:
+        L = self.L
+        if want < L:
+            raise ConfigMismatchError(
+                f"{key}: target flat length {want} is shorter than the "
+                f"logical param length {L} — not a world-size change"
+            )
+        if arr[L:].any():
+            # the pad tail of a ZeRO-1 flat vector is zero by construction
+            # (pad gradients are zero, decay intervals stop at L); nonzero
+            # means this is NOT the layout we think it is — refuse rather
+            # than silently drop optimizer state
+            raise ConfigMismatchError(
+                f"{key}: flat optimizer vector has nonzero entries past the "
+                f"logical param length {L} — the checkpoint's layout does "
+                "not match the ZeRO-1 elastic contract; refusing to remap"
+            )
+        out = np.zeros((want,), arr.dtype)
+        out[:L] = arr[:L]  # bit-exact: verbatim copy, dtype preserved
+        return out
+
+    def _remap_ef_r1(self, key: str, arr: np.ndarray, want: int) -> np.ndarray:
+        if self.n_old is None:
+            raise ConfigMismatchError(
+                f"{key}: checkpoint predates the elastic 'dp' stamp, so the "
+                "per-replica row count of the r1 residuals is unknown — "
+                "resume at the original world size once (re-stamping), or "
+                "drop to a clean-epoch checkpoint"
+            )
+        if arr.size % self.n_old:
+            raise ConfigMismatchError(
+                f"{key}: r1 length {arr.size} does not divide into "
+                f"{self.n_old} replica rows — stamp/layout disagreement"
+            )
+        p_old = arr.size // self.n_old
+        if want % self.n_new:
+            raise ConfigMismatchError(
+                f"{key}: target r1 length {want} does not divide into "
+                f"{self.n_new} replica rows"
+            )
+        p_new = want // self.n_new
+        crop = min(self.L, p_old, p_new)
+        # aggregate-exact: the reduce sums every replica's compensated
+        # contribution, so folding all rows into new replica 0 preserves
+        # the total error to the bit; pad-coordinate residuals (past L)
+        # chase phantom parameters and are dropped
+        total = arr.reshape(self.n_old, p_old)[:, :crop].sum(
+            axis=0, dtype=arr.dtype
+        )
+        out = np.zeros((want,), arr.dtype)
+        out[:crop] = total
+        return out
+
+    def _remap_ef_r2(self, key: str, arr: np.ndarray, want: int) -> np.ndarray:
+        # positional over the reduced gradient: bit-exact crop + zero re-pad
+        keep = min(self.L, arr.size, want)
+        out = np.zeros((want,), arr.dtype)
+        out[:keep] = arr[:keep]
+        return out
+
+
+def make_remapper(template_state, meta: Optional[dict], n_new: int) -> Remapper:
+    """Build the restore-ladder remapper for one checkpoint candidate:
+    ``L`` comes from the live template (the param tree is world-size-
+    independent, so it equals the checkpoint's), ``n_old`` from the
+    checkpoint's ``elastic`` stamp (None for pre-stamp checkpoints — only
+    ``r1`` needs it and raises a pointed error without it). A stamped
+    ``params_len`` that disagrees with the template is a different MODEL,
+    not a world-size change — :class:`ConfigMismatchError`."""
+    L = params_len(template_state.params)
+    el = (meta or {}).get("elastic") or {}
+    stamped = el.get("params_len")
+    if stamped is not None and int(stamped) != L:
+        raise ConfigMismatchError(
+            f"checkpoint was written with params_len={stamped} but the live "
+            f"model ravels to {L} parameters — a different model, not a "
+            "world-size change; elastic remap refused"
+        )
+    return Remapper(L, n_new, n_old=el.get("dp"))

@@ -1,0 +1,156 @@
+"""Elastic relaunch policy for the local launcher (``cli/launch.py``).
+
+On a shared/preemptible pool, losing part of the pod is routine, not
+fatal: pod-scale practice treats worker loss as a reschedule, and a run
+that can continue at a reduced world size survives the night where an
+"identical size or nothing" run waits in the queue. This module is the
+policy half — pure, deterministic, jax-free — the launcher supplies the
+mechanism (spawn a round of workers, collect per-rank exits).
+
+The loop:
+
+1. Run a round at world size ``n``.
+2. Clean exit → done. Otherwise classify each rank's exit: a rank that
+   ended 0, with the preemption code (75), or on the launcher's own
+   forwarded SIGTERM is a **survivor** (it can be rescheduled); anything
+   else — a hard kill, a crash, a watchdog SIGKILL — is **lost**.
+3. Whole-pod preemption (nothing lost) relaunches at the same size;
+   lost ranks shrink the next round to the largest divisor of the
+   ORIGINAL world size that fits the survivors (divisors keep the global
+   batch's divisibility story intact) and stays >= ``min_procs``.
+4. Each relaunch waits the deterministic exponential backoff of
+   ``resilience/retry.py`` (injectable sleep, no jitter) and is bounded
+   by ``max_restarts`` — a deterministic crash loop burns its budget and
+   surfaces the real exit code instead of cycling forever.
+
+The mid-run *state* story (checkpoint remap onto the new dp extent,
+sampler re-partitioning) lives in ``tpu_dist/elastic/remap.py`` and the
+trainer's restore ladder; the relaunched children just run ``--resume``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import signal
+import time
+from typing import Callable, Dict, Optional
+
+from tpu_dist.resilience.preemption import PREEMPTION_EXIT_CODE
+from tpu_dist.resilience.retry import backoff_delays
+
+#: Exit statuses that mark a rank as reschedulable: clean, the cooperative
+#: preemption code, and death by the launcher's own forwarded SIGTERM
+#: (a child preempted before its handler was installed).
+SURVIVOR_EXITS = frozenset({0, PREEMPTION_EXIT_CODE, -int(signal.SIGTERM)})
+
+
+@dataclasses.dataclass
+class RoundResult:
+    """One launcher round's outcome: the aggregate exit code the launcher
+    would have returned, and each rank's raw exit status."""
+
+    rc: int
+    rank_exits: Dict[int, int]
+
+    def survivors(self) -> int:
+        return sum(
+            1 for code in self.rank_exits.values() if code in SURVIVOR_EXITS
+        )
+
+    def lost(self) -> int:
+        return len(self.rank_exits) - self.survivors()
+
+
+def feasible_sizes(original: int) -> list:
+    """Candidate world sizes, largest first: the divisors of the original
+    launch size. A divisor keeps every 'global value divides over the
+    world' property (batch, dataset sharding) that held at full size."""
+    return [n for n in range(original, 0, -1) if original % n == 0]
+
+
+def next_world_size(
+    original: int, survivors: int, min_procs: int
+) -> Optional[int]:
+    """Largest feasible world size that the surviving ranks can staff and
+    that honors the ``--elastic_min_procs`` floor; None when no such size
+    exists (the run must fail rather than limp below the floor)."""
+    for n in feasible_sizes(original):
+        if n <= survivors and n >= max(1, min_procs):
+            return n
+    return None
+
+
+def supervise(
+    run_round: Callable[[int, int], RoundResult],
+    *,
+    nproc: int,
+    min_procs: int,
+    max_restarts: int,
+    backoff_base: float = 0.5,
+    backoff_max: float = 30.0,
+    sleep: Optional[Callable[[float], None]] = None,
+    announce: Optional[Callable[[str], None]] = None,
+    should_continue: Optional[Callable[[], bool]] = None,
+) -> int:
+    """Drive ``run_round(world_size, restart_index)`` until the run
+    completes, the restart budget is spent, or the pod shrinks below the
+    floor. Returns the exit code of the final round (0 on success).
+
+    ``should_continue`` is consulted before every relaunch: the launcher
+    passes "I was not myself SIGTERMed" — when the ORCHESTRATOR preempts
+    the whole job (signal to the launcher), elastic must surface the
+    requeue code upward, not fight the scheduler by relaunching locally."""
+    do_sleep = sleep if sleep is not None else time.sleep
+    say = announce if announce is not None else (lambda _msg: None)
+    keep_going = should_continue if should_continue is not None else (lambda: True)
+    delays = backoff_delays(max(1, max_restarts), backoff_base, backoff_max)
+    n = nproc
+    res = run_round(n, 0)
+    for restart in range(max_restarts):
+        if res.rc == 0:
+            return 0
+        if not keep_going():
+            say(
+                "elastic: the launcher itself was asked to stop — "
+                f"surfacing exit {res.rc} instead of relaunching"
+            )
+            return res.rc
+        lost = res.lost()
+        survivors = res.survivors()  # the census is the single source
+        if lost == 0:
+            # whole-pod preemption: every rank is reschedulable — retry at
+            # the same size (the orchestrator-requeue case, done locally)
+            target = n
+        else:
+            target = next_world_size(nproc, survivors, min_procs)
+            if target is None:
+                say(
+                    f"elastic: only {survivors} of {n} rank(s) survived — "
+                    f"no feasible world size >= min_procs={min_procs}; "
+                    f"giving up with exit {res.rc}"
+                )
+                return res.rc
+        delay = delays[min(restart, len(delays) - 1)]
+        say(
+            f"elastic: relaunching at world size {target} (was {n}, "
+            f"{lost} rank(s) lost; restart {restart + 1}/{max_restarts}, "
+            f"backoff {delay:g}s)"
+        )
+        do_sleep(delay)
+        if not keep_going():
+            # the stop request can land DURING the backoff window — a
+            # relaunch after it would fight the scheduler with a whole
+            # fresh world; surface the last round's code instead
+            say(
+                "elastic: stop requested during backoff — surfacing exit "
+                f"{res.rc} instead of relaunching"
+            )
+            return res.rc
+        n = target
+        res = run_round(n, restart + 1)
+    if res.rc != 0:
+        say(
+            f"elastic: restart budget ({max_restarts}) spent; surfacing "
+            f"exit {res.rc}"
+        )
+    return res.rc

@@ -50,7 +50,9 @@ import jax
 
 from tpu_dist.obs import counters as counters_lib
 
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7  # v7 (additive): 'resume' segment-boundary records
+#                     (world size, elastic reshard flag, re-entry position
+#                     — docs/resilience.md "Elastic training")
 
 
 class MetricsHistory:

@@ -152,6 +152,10 @@ def pod_report(
             "profiles": rep.get("profiles", []),
             "profile_analyses": rep.get("profile_analyses", []),
             "skipped_kinds": rep.get("skipped_kinds", {}),
+            # elastic segment boundaries (schema v7): the pod's host set
+            # is NOT fixed across segments — surface world-size changes
+            "resumes": rep.get("resumes", []),
+            "world_sizes": rep.get("world_sizes", []),
         })
     fracs = [
         h["goodput"]["goodput_frac"] for h in hosts
@@ -229,6 +233,19 @@ def format_text(report: dict) -> str:
             + f" {cell(h.get('images_per_sec_mean'), '.1f', 9)}"
             + f" {cell(gp.get('n_segments'), 'd', 4)}"
         )
+    # elastic segments: a world-size change mid-log means later epoch
+    # rows ran on a DIFFERENT host/device set — the skew table and the
+    # per-host ledgers must be read per segment, so say so explicitly
+    for h in report["hosts"]:
+        ws = h.get("world_sizes") or []
+        if len(ws) > 1:
+            lines.append(
+                f"elastic on {h['host']}: world size dp "
+                + " -> ".join(str(x) for x in ws)
+                + " ("
+                + str(sum(1 for r in h.get("resumes", []) if r.get("resharded")))
+                + " resharded resume(s)) — host set not fixed across segments"
+            )
     # per-host profiler captures: paths + the xprof analysis rollup, so
     # the pod view answers WHERE each capture lives and WHAT it said —
     # not just who heartbeats and who straggles

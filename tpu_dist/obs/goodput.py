@@ -158,8 +158,12 @@ def run_ledger(records: List[dict]) -> Optional[dict]:
     gap between a segment's LAST record and the next segment's
     construction instant (its first record's ``ts - rel_s``) is the
     restart loss nobody inside either process could see — it lands in
-    ``preempt_s``. Returns None when the log holds no goodput records
-    (an old-schema log)."""
+    ``preempt_s``, except when the new segment opens with a RESHARDED
+    ``resume`` record (an elastic shrink/grow, schema v7): that gap is
+    the reshard+relaunch cost of keeping the run alive at a new world
+    size and is charged to ``recovery_s`` instead (docs/resilience.md
+    "Elastic training"). Returns None when the log holds no goodput
+    records (an old-schema log)."""
     totals = _zero_totals()
     n_segments = 0
     saw_goodput = False
@@ -169,6 +173,7 @@ def run_ledger(records: List[dict]) -> Optional[dict]:
     seg_has_window = False
     last_ts: Optional[float] = None
     restart_s = 0.0
+    reshard_gap_s = 0.0
 
     def fold_segment():
         nonlocal seg_final, seg_windows, seg_has_window
@@ -194,14 +199,21 @@ def run_ledger(records: List[dict]) -> Optional[dict]:
             # schemas, foreign lines — never split a segment
             fold_segment()
             # restart gap: previous segment's last visible instant to
-            # this segment's construction (ts minus its rel_s offset)
+            # this segment's construction (ts minus its rel_s offset).
+            # A segment whose boundary record is a resharded 'resume'
+            # came back at a NEW world size — its gap is elastic
+            # recovery, not preemption loss
             ts, rel = rec.get("ts"), rec.get("rel_s")
             if (
                 last_ts is not None
                 and isinstance(ts, (int, float))
                 and isinstance(rel, (int, float))
             ):
-                restart_s += max(float(ts) - float(rel) - last_ts, 0.0)
+                gap = max(float(ts) - float(rel) - last_ts, 0.0)
+                if rec.get("kind") == "resume" and rec.get("resharded"):
+                    reshard_gap_s += gap
+                else:
+                    restart_s += gap
             cur_run = rid
             n_segments += 1
         if isinstance(rec.get("ts"), (int, float)):
@@ -220,8 +232,11 @@ def run_ledger(records: List[dict]) -> Optional[dict]:
     if not saw_goodput:
         return None
     totals["preempt_s"] = round(totals["preempt_s"] + restart_s, 4)
-    totals["restart_gap_s"] = round(restart_s, 4)
-    totals["elapsed_s"] = round(totals["elapsed_s"] + restart_s, 4)
+    totals["recovery_s"] = round(totals["recovery_s"] + reshard_gap_s, 4)
+    totals["restart_gap_s"] = round(restart_s + reshard_gap_s, 4)
+    totals["elapsed_s"] = round(
+        totals["elapsed_s"] + restart_s + reshard_gap_s, 4
+    )
     for b in ALL_BUCKETS:
         totals[f"{b}_s"] = round(totals[f"{b}_s"], 4)
     totals["n_segments"] = n_segments

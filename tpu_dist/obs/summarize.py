@@ -29,14 +29,14 @@ from tpu_dist.obs import goodput as goodput_lib
 #: kinds summarized; their unknown kinds are skipped with a count — the
 #: forward-compat contract that lets v3 tooling read v4 logs and vice
 #: versa (every schema bump is additive).
-SUPPORTED_SCHEMA = 6
+SUPPORTED_SCHEMA = 7
 
 #: Record kinds this reader folds into the report. Anything else is
 #: counted into ``skipped_kinds`` — never an error, never silent.
 KNOWN_KINDS = frozenset((
     "train_epoch", "eval", "straggler", "anomaly", "device_stats",
     "auto_recover", "spans", "goodput", "profile", "alert",
-    "profile_analysis",
+    "profile_analysis", "resume",
 ))
 
 
@@ -73,6 +73,8 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
     profiles: List[dict] = []
     profile_analyses: List[dict] = []
     goodput_epochs: List[dict] = []
+    resumes: List[dict] = []  # segment boundaries (world size, reshard)
+    world_sizes: List[int] = []  # distinct dp extents, in order of appearance
     dstats: dict = {}  # epoch -> per-epoch device_stats aggregate
     recoveries = 0
     prev_counters: Optional[dict] = None
@@ -134,6 +136,30 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
                     d[f"{key}_last"] = v
         elif kind == "auto_recover":
             recoveries += 1
+        elif kind == "resume":
+            # segment boundary (schema v7): the host set is NOT fixed —
+            # an elastic relaunch changes the world size mid-log, and the
+            # report must say so instead of silently merging segments
+            resumes.append({
+                k: rec.get(k)
+                for k in ("epoch", "world", "dp", "prev_dp", "prev_procs",
+                          "resharded", "restarts", "mid_epoch_step",
+                          "examples_offset")
+                if rec.get(k) is not None
+            })
+            # the FIRST segment logs no resume record (fresh starts
+            # don't), so seed the world-size history from the resumed
+            # checkpoint's stamped previous extent — otherwise the
+            # canonical single shrink would read as one world size and
+            # the change banner would never render
+            prev_dp = rec.get("prev_dp")
+            if not world_sizes and isinstance(prev_dp, int):
+                world_sizes.append(prev_dp)
+            dp = rec.get("dp")
+            if isinstance(dp, int) and (
+                not world_sizes or world_sizes[-1] != dp
+            ):
+                world_sizes.append(dp)
         elif kind == "profile":
             profiles.append({
                 k: rec.get(k)
@@ -220,6 +246,8 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
         "newer_schema_records": newer_schema_records,
         "epochs": epochs,
         "partial_epoch_device_stats": partial,
+        "resumes": resumes,
+        "world_sizes": world_sizes,
         "stragglers": stragglers,
         "anomalies": anomalies,
         "alerts": alerts,
@@ -267,6 +295,31 @@ def format_text(report: dict) -> str:
             f"schema version newer than this reader supports "
             f"({SUPPORTED_SCHEMA}) — known kinds are summarized, the rest "
             "skipped above"
+        )
+    ws = report.get("world_sizes") or []
+    if len(ws) > 1:
+        lines.append(
+            "world size changed mid-run (elastic): dp "
+            + " -> ".join(str(w) for w in ws)
+            + " — epoch rows below span DIFFERENT host/device sets"
+        )
+    for rs in report.get("resumes", []):
+        pos = (
+            f" at step {rs['mid_epoch_step']}" if rs.get("mid_epoch_step")
+            else f" at example offset {rs['examples_offset']}"
+            if rs.get("examples_offset") else ""
+        )
+        lines.append(
+            f"segment: resumed epoch {rs.get('epoch')}{pos} on "
+            f"{rs.get('world')} process(es), dp={rs.get('dp')}"
+            + (
+                f" (RESHARDED from dp={rs.get('prev_dp')})"
+                if rs.get("resharded") else ""
+            )
+            + (
+                f" — elastic restart #{rs['restarts']}"
+                if rs.get("restarts") else ""
+            )
         )
     hdr = (
         f"{'epoch':>5} {'img/s':>9} {'epoch_s':>8} {'p50_ms':>8} "

@@ -192,6 +192,23 @@ class TailState:
                     f"auto-recover at epoch {ep} (lr_scale "
                     f"{rec.get('lr_scale')})"
                 )
+            elif kind == "resume":
+                # segment boundary with world-size context (schema v7):
+                # the host set is not fixed — say which world this
+                # segment runs at and whether the state was resharded
+                self._event(
+                    f"resumed epoch {ep} on {rec.get('world')} "
+                    f"process(es), dp={rec.get('dp')}"
+                    + (
+                        f" — RESHARDED from dp={rec.get('prev_dp')} "
+                        "(elastic)"
+                        if rec.get("resharded") else ""
+                    )
+                    + (
+                        f", restart #{rec.get('restarts')}"
+                        if rec.get("restarts") else ""
+                    )
+                )
 
     def _event(self, line: str) -> None:
         self.events.append(line)

@@ -24,6 +24,14 @@ run after run (the property the bit-identical chaos tests in
 ``sigterm@step=S[:epoch=E]``
     Deliver a **real** ``SIGTERM`` to this process at step S — exercises
     the preemption-graceful shutdown end to end, signal delivery included.
+``rank_kill@step=S:rank=R[:epoch=E]``
+    Deliver a **real** ``SIGKILL`` to process rank R at step S — the
+    hard-death half of the elastic drill (docs/resilience.md "Elastic
+    training"): no handler runs, no emergency save, the rank is simply
+    gone, and the elastic launcher must relaunch the survivors at a
+    reduced world size. The trainer passes its process rank into
+    :func:`on_step`; a clause pinning a rank never fires on a process
+    whose rank is unknown.
 ``loader_stall@batch=B[:epoch=E]``
     Kill the data-loader producer thread before it publishes batch B
     (it exits without its end-of-epoch sentinel, exactly like a thread
@@ -57,18 +65,23 @@ ENV_VAR = "TPU_DIST_FAULT_PLAN"
 # action names surfaced to the trainer by on_step()
 NAN_LOSS = "nan_loss"
 SIGTERM = "sigterm"
+RANK_KILL = "rank_kill"
 
-SITES = ("ckpt_write", "ckpt_corrupt", "nan_loss", "sigterm", "loader_stall")
+SITES = (
+    "ckpt_write", "ckpt_corrupt", "nan_loss", "sigterm", "loader_stall",
+    "rank_kill",
+)
 
 _CKPT_NAME_RE = re.compile(r"ckpt_(\d+)\.(?:npz|manifest\.json)$")
 
-_INT_KEYS = {"call", "times", "errno", "epoch", "step", "batch", "seed"}
+_INT_KEYS = {"call", "times", "errno", "epoch", "step", "batch", "seed", "rank"}
 _ALLOWED_KEYS = {
     "ckpt_write": {"call", "times", "errno"},
     "ckpt_corrupt": {"epoch", "mode", "seed", "frac", "times"},
     "nan_loss": {"step", "epoch", "times"},
     "sigterm": {"step", "epoch", "times"},
     "loader_stall": {"batch", "epoch", "times"},
+    "rank_kill": {"step", "rank", "epoch", "times"},
 }
 _REQUIRED_KEYS = {
     "ckpt_write": {"call"},
@@ -76,6 +89,7 @@ _REQUIRED_KEYS = {
     "nan_loss": {"step"},
     "sigterm": {"step"},
     "loader_stall": {"batch"},
+    "rank_kill": {"step", "rank"},
 }
 
 
@@ -282,10 +296,14 @@ def on_ckpt_published(path: str) -> Optional[str]:
     return None
 
 
-def on_step(epoch: int, step: int) -> FrozenSet[str]:
+def on_step(epoch: int, step: int, rank: Optional[int] = None) -> FrozenSet[str]:
     """Called once per completed train step (host side). Returns the set of
     actions the trainer must apply ({'nan_loss'}); a matching ``sigterm``
-    clause delivers a REAL signal to this process right here."""
+    clause delivers a REAL signal to this process right here, and a
+    matching ``rank_kill`` clause (step + the caller's ``rank``) delivers
+    a REAL ``SIGKILL`` — the hard rank death the elastic launcher must
+    survive. ``rank=None`` (callers that don't know their rank) never
+    matches a rank-pinned clause."""
     plan = _PLAN
     if plan is None:
         return frozenset()
@@ -299,6 +317,13 @@ def on_step(epoch: int, step: int) -> FrozenSet[str]:
         _record_fired("sigterm")
         actions.add(SIGTERM)
         os.kill(os.getpid(), signal.SIGTERM)
+    for c in plan._matching("rank_kill", epoch=epoch, step=step, rank=rank):
+        c.fired += 1
+        _record_fired("rank_kill")
+        actions.add(RANK_KILL)
+        # hard death by design: no handler, no emergency save, no exit
+        # code discipline — the process is simply gone mid-run
+        os.kill(os.getpid(), signal.SIGKILL)
     return frozenset(actions)
 
 

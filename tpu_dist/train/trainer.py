@@ -171,7 +171,7 @@ class Trainer:
         if plan is not None and cfg.fused_epoch:
             stepwise = sorted(
                 {c.site for c in plan.clauses}
-                & {"nan_loss", "sigterm", "loader_stall"}
+                & {"nan_loss", "sigterm", "loader_stall", "rank_kill"}
             )
             if stepwise:
                 raise ValueError(
@@ -903,19 +903,42 @@ class Trainer:
             spans_lib.enable()
         self.start_epoch = 0
         self._resume_step = 0  # >0 only after restoring a mid-epoch snapshot
+        self._resume_examples = 0  # >0 only on an ELASTIC mid-epoch resume
+        #                            (consumed-prefix offset; sampler.set_offset)
+        self._epoch_start_examples = 0  # the running epoch's entry offset
+        # logical param length L — the world-size-independent coordinate
+        # every elastic flat layout (ZeRO-1 opt vectors, EF residuals) is
+        # padded from; stamped into every checkpoint's elastic meta
+        from tpu_dist.elastic.remap import params_len  # noqa: PLC0415
+
+        self._params_len = params_len(self.state.params)
+        self._last_reshard_s = 0.0  # wall time of the last elastic remap
+        self._elastic_resume = None  # 'resume' history record, logged by fit
         # atomic training position for _emergency_save: (state, epoch,
         # steps_done, epoch_complete). Fresh start = complete through
         # epoch -1 (nothing to snapshot); _restore_latest re-publishes.
         self._progress = (self.state, -1, 0, True)
         if cfg.resume and cfg.ckpt_dir:
             # template = current state (matches sharded layouts too);
-            # raises on a format-mismatched ckpt_dir (_restore_latest)
-            with self._goodput.timed("ckpt"):
-                epoch = self._restore_latest()
+            # raises on a format-mismatched ckpt_dir (_restore_latest).
+            # Goodput: the plain restore is ckpt time, but an ELASTIC
+            # reshard (restore onto a new dp extent) is recovery time —
+            # the ledger's recovery_s bucket carries reshard+relaunch cost
+            t_res = time.monotonic()
+            epoch = self._restore_latest()
+            restore_s = time.monotonic() - t_res
+            self._goodput.add(
+                "ckpt", max(restore_s - self._last_reshard_s, 0.0)
+            )
+            self._goodput.add("recovery", self._last_reshard_s)
             if epoch is not None:
                 # a mid-epoch snapshot re-enters its own epoch at the saved
-                # step; a clean end-of-epoch ckpt starts the next epoch
-                self.start_epoch = epoch if self._resume_step else epoch + 1
+                # step (or, elastically, at the consumed-example offset); a
+                # clean end-of-epoch ckpt starts the next epoch
+                self.start_epoch = (
+                    epoch if (self._resume_step or self._resume_examples)
+                    else epoch + 1
+                )
                 self._seed_global_step()
 
     def _seed_global_step(self) -> None:
@@ -1016,12 +1039,57 @@ class Trainer:
             # auto-recovery backoff survives preemption: a --resume that
             # replayed the UNSCALED schedule would re-diverge identically
             meta["lr_scale"] = self._lr_scale
+        # mesh-shape portability stamp (docs/resilience.md "Elastic
+        # training"): the dp extent the state is laid out for, the process
+        # count (the sampler's shard count), and the logical param length
+        # — what a restore onto a DIFFERENT world size needs to remap the
+        # ZeRO-1/EF flat layouts deterministically
+        from tpu_dist.elastic.remap import elastic_stamp  # noqa: PLC0415
+
+        meta["elastic"] = elastic_stamp(
+            self.n_data, mesh_lib.process_count(), self._params_len
+        )
         return meta
+
+    def _mid_epoch_position(self, steps_done: int) -> dict:
+        """The data-position stamps of a mid-epoch snapshot. The legacy
+        triple (step, GLOBAL batch size, seed) pins the position exactly
+        for a same-world resume; ``mid_epoch_examples`` (the consumed
+        prefix of the epoch permutation — entry offset plus steps since)
+        and ``mid_epoch_procs`` make it world-portable: a resume at a
+        different process count re-partitions ``order[examples:]`` over
+        the new shards so nothing is dropped or double-seen."""
+        cfg = self.cfg
+        # clamp to the dataset size: the final batch of a drop_last=False
+        # epoch is wrap-around padded, so step*batch can overshoot N — an
+        # unclamped stamp would make the elastic resume's set_offset raise
+        # at exactly the moment the feature exists for (offset == N means
+        # "nothing left of this epoch", which is the truth)
+        consumed = min(
+            self._epoch_start_examples + steps_done * cfg.batch_size,
+            len(self.train_data[0]),
+        )
+        return {
+            "mid_epoch_step": int(steps_done),
+            "mid_epoch_batch_size": cfg.batch_size,
+            "mid_epoch_seed": cfg.seed or 0,
+            "mid_epoch_procs": mesh_lib.process_count(),
+            "mid_epoch_examples": int(consumed),
+        }
 
     def _check_ckpt_layout(self, path: str) -> None:
         self._check_ckpt_meta(ckpt_lib.read_meta(path), path)
 
     def _check_ckpt_meta(self, meta: dict, path: str) -> None:
+        """Config-mismatch stamp checks. Everything here raises the typed
+        :class:`ConfigMismatchError` — OPERATOR errors a restore must not
+        fall past. A world-size change deliberately does NOT land here: it
+        surfaces as :class:`ElasticShapeMismatch` from the checkpoint
+        layer and is handled by the elastic remapper (docs/resilience.md
+        "Elastic training"), so shrinking the pod no longer pattern-
+        matches to config drift."""
+        from tpu_dist.elastic.errors import ConfigMismatchError  # noqa: PLC0415
+
         cfg = self.cfg
         ck_v = meta.get("pp_interleave")
         ck_pp = meta.get("pp")
@@ -1029,7 +1097,7 @@ class Trainer:
             # pre-layout-tag checkpoint: blocks are in logical depth order —
             # loadable only by non-interleaved configs
             if cfg.pp_interleave > 1:
-                raise ValueError(
+                raise ConfigMismatchError(
                     f"checkpoint {path} has no pipeline-layout tag (written "
                     f"before interleaving existed, logical block order) — it "
                     f"cannot be resumed with pp_interleave={cfg.pp_interleave}"
@@ -1038,7 +1106,7 @@ class Trainer:
         if ck_v != cfg.pp_interleave or (
             (ck_v > 1 or cfg.pp_interleave > 1) and ck_pp != cfg.pp
         ):
-            raise ValueError(
+            raise ConfigMismatchError(
                 f"checkpoint {path} was written with pp={ck_pp}, "
                 f"pp_interleave={ck_v} — its block storage order is "
                 f"layout-specific; resume with the same flags (got "
@@ -1058,7 +1126,7 @@ class Trainer:
                     "on bias/norm leaves silently changes from here on"
                 )
             elif ck_mask != cfg.adamw_decay_mask:
-                raise ValueError(
+                raise ConfigMismatchError(
                     f"checkpoint {path} was trained with adamw_decay_mask="
                     f"{ck_mask!r} but this run uses "
                     f"{cfg.adamw_decay_mask!r} — the opt-state shapes are "
@@ -1186,18 +1254,29 @@ class Trainer:
 
     # -- loops ---------------------------------------------------------------
 
-    def train_epoch(self, epoch: int, start_step: int = 0) -> dict:
+    def train_epoch(
+        self, epoch: int, start_step: int = 0, start_examples: int = 0
+    ) -> dict:
         if self._fused_runner is not None:
-            if start_step:
+            if start_step or start_examples:
                 raise ValueError(
                     "mid-epoch resume (checkpoint carries mid_epoch_step="
-                    f"{start_step}) is not possible with --fused_epoch: the "
-                    "whole epoch is one compiled call; resume without "
-                    "--fused_epoch to continue from the exact batch"
+                    f"{start_step or start_examples}) is not possible with "
+                    "--fused_epoch: the whole epoch is one compiled call; "
+                    "resume without --fused_epoch to continue from the "
+                    "exact batch"
                 )
             return self._train_epoch_fused(epoch)
         cfg = self.cfg
         self.train_sampler.set_epoch(epoch)  # shuffle correctness (tutorials/2:§2)
+        if start_examples:
+            # elastic mid-epoch re-entry: skip the old world's consumed
+            # prefix of the epoch permutation and re-partition the
+            # remainder over THIS world's shards (exactness argument in
+            # sampler.set_offset; set_epoch above cleared any prior offset
+            # so only the resumed epoch is shortened)
+            self.train_sampler.set_offset(start_examples)
+        self._epoch_start_examples = start_examples
         lr = self._lr(epoch)
         losses = AverageMeter("Loss", ":.4e")  # epoch-avg of the logged steps
         images_seen = 0
@@ -1338,9 +1417,7 @@ class Trainer:
                     self._ckpt_io().save(
                         cfg.ckpt_dir, new_state, epoch, cfg.keep_last_ckpts,
                         extra_meta={**self._ckpt_meta(),
-                                    "mid_epoch_step": step + 1,
-                                    "mid_epoch_batch_size": cfg.batch_size,
-                                    "mid_epoch_seed": cfg.seed or 0},
+                                    **self._mid_epoch_position(step + 1)},
                     )
             if want_log:
                 if cfg.nan_guard and not np.isfinite(m["loss"]):
@@ -1649,11 +1726,7 @@ class Trainer:
                 # --async_ckpt: a rare forensic event, not hot-path I/O.
                 extra = {**self._ckpt_meta(), "anomaly": f["anomaly"]}
                 if step is not None:
-                    extra.update(
-                        mid_epoch_step=step + 1,
-                        mid_epoch_batch_size=cfg.batch_size,
-                        mid_epoch_seed=cfg.seed or 0,
-                    )
+                    extra.update(self._mid_epoch_position(step + 1))
                 stem = f"anomaly_{epoch}" + (
                     f"_s{step + 1}" if step is not None else ""
                 )
@@ -1851,7 +1924,7 @@ class Trainer:
         loop's preemption check picks it up); ``nan_loss`` reports a
         divergence through the SAME error type the NaN guard uses, so the
         existing auto-recover machinery runs unmodified."""
-        acts = faults.on_step(epoch, step)
+        acts = faults.on_step(epoch, step, rank=mesh_lib.process_index())
         if faults.NAN_LOSS in acts:
             if self.cfg.nan_guard:
                 raise TrainingDivergedError(
@@ -1968,6 +2041,10 @@ class Trainer:
                 )
             self._check_ladder_agreement(-1)
             return None
+        from tpu_dist.elastic import remap as elastic_remap  # noqa: PLC0415
+
+        self._last_reshard_s = 0.0
+        self._elastic_resume = None
         chosen = None
         for path, epoch in candidates:
             try:
@@ -1978,17 +2055,41 @@ class Trainer:
                 self._quarantine_ckpt(path, e)
                 continue
             # config-mismatch checks on the (readable) meta: a valid-but-
-            # wrong checkpoint must raise, not be quarantined as corrupt
+            # wrong checkpoint must raise (ConfigMismatchError), not be
+            # quarantined as corrupt
             self._check_ckpt_meta(meta, path)
+            # mesh-shape portability: restore WITH the elastic remapper —
+            # world-size-independent leaves load verbatim; the dp-extent-
+            # dependent flat layouts (ZeRO-1 opt vectors, EF residuals)
+            # are remapped onto THIS run's extent (elastic/remap.py).
+            # A model-shape mismatch still raises (ConfigMismatchError,
+            # from make_remapper's params_len check or the restore).
+            remapper = elastic_remap.make_remapper(
+                self.state, meta, self.n_data
+            )
+            t_restore = time.monotonic()
             try:
                 with spans_lib.span("ckpt/restore_ladder", file=path):
-                    restored = restore_(path, self.state)
+                    restored = restore_(path, self.state, remap=remapper)
             except (ckpt_lib.CheckpointCorruptError,) + ckpt_lib.CKPT_READ_ERRORS as e:
                 # plain format verifies CRCs HERE (fused into restore's
                 # read); sharded piece-level corruption also lands here
                 self._quarantine_ckpt(path, e)
                 continue
-            chosen = (path, epoch, meta, restored)
+            if remapper.used:
+                # this restore WAS the reshard: charge its wall time to the
+                # goodput recovery bucket (the __init__ caller splits it
+                # out of the ckpt bucket) and count it
+                self._last_reshard_s = time.monotonic() - t_restore
+                counters_lib.inc("resume.resharded")
+                prev_dp = (meta.get("elastic") or {}).get("dp")
+                rank0_print(
+                    f"=> elastic resume: remapped {len(remapper.used)} "
+                    f"dp-extent-dependent leaf(s) from dp={prev_dp} onto "
+                    f"dp={self.n_data} (ZeRO-1/EF flat layouts re-laid — "
+                    "docs/resilience.md 'Elastic training')"
+                )
+            chosen = (path, epoch, meta, restored, bool(remapper.used))
             break
         self._check_ladder_agreement(chosen[1] if chosen is not None else -1)
         if chosen is None:
@@ -1997,24 +2098,29 @@ class Trainer:
                 "and has been quarantined — starting from scratch"
             )
             return None
-        path, epoch, meta, restored = chosen
+        path, epoch, meta, restored, resharded = chosen
         self.state = self._place_state(restored)
         # pick the recovery backoff up from the checkpoint (see _ckpt_meta)
         self._lr_scale = float(meta.get("lr_scale", 1.0))
         # exact mid-epoch snapshot (emergency save): re-enter THIS epoch at
         # this step instead of starting the next epoch
         self._resume_step = int(meta.get("mid_epoch_step", 0))
+        self._resume_examples = 0
         if self._resume_step:
-            # the step offset pins the data position only under the SAME
-            # per-process batch size and shuffle seed — refuse silent drift
-            # (same contract as the pp/adamw layout stamps above)
+            from tpu_dist.elastic.errors import (  # noqa: PLC0415
+                ConfigMismatchError,
+            )
+
+            # the GLOBAL batch size and shuffle seed pin the data position
+            # under ANY world size — refuse silent drift (same contract as
+            # the pp/adamw layout stamps above)
             for key, current in (
                 ("mid_epoch_batch_size", cfg.batch_size),
                 ("mid_epoch_seed", cfg.seed or 0),
             ):
                 saved = meta.get(key)
                 if saved is not None and saved != current:
-                    raise ValueError(
+                    raise ConfigMismatchError(
                         f"checkpoint {path} is a mid-epoch snapshot taken "
                         f"with {key.removeprefix('mid_epoch_')}={saved}; "
                         f"this run uses {current} — the step offset would "
@@ -2023,12 +2129,59 @@ class Trainer:
                         f"with the matching value, or from the last clean "
                         f"epoch checkpoint."
                     )
+            # world-portable re-entry: the per-shard step offset replays
+            # bit-identically only when the shard count is unchanged AND
+            # the snapshot itself entered its epoch at offset 0. Otherwise
+            # switch to the consumed-example offset: skip the globally
+            # consumed prefix and re-partition the remainder over THIS
+            # world's shards (sampler.set_offset — nothing dropped or
+            # double-seen; augmentation streams re-key, so the continued
+            # trajectory is parity, not bit-identity).
+            nproc = mesh_lib.process_count()
+            saved_procs = meta.get("mid_epoch_procs")
+            saved_ex = meta.get("mid_epoch_examples")
+            same_world = saved_procs is None or int(saved_procs) == nproc
+            offset_free = (
+                saved_ex is None
+                or int(saved_ex) == self._resume_step * cfg.batch_size
+            )
+            if not (same_world and offset_free):
+                # clamp defensively too (pre-clamp or foreign stamps): an
+                # offset at N is a legally-empty resumed epoch, past N is
+                # not a position in this dataset
+                self._resume_examples = min(
+                    int(
+                        saved_ex
+                        if saved_ex is not None
+                        else self._resume_step * cfg.batch_size
+                    ),
+                    len(self.train_data[0]),
+                )
+                self._resume_step = 0
         self._state_poisoned = False
+        self._elastic_resume = {
+            "epoch": epoch,
+            "world": mesh_lib.process_count(),
+            "dp": self.n_data,
+            "resharded": resharded,
+            "prev_dp": (meta.get("elastic") or {}).get("dp"),
+            "prev_procs": (meta.get("elastic") or {}).get("procs"),
+            "mid_epoch_step": self._resume_step,
+            "examples_offset": self._resume_examples,
+        }
         if self._resume_step:
             self._progress = (self.state, epoch, self._resume_step, False)
             rank0_print(
                 f"=> resumed from {path} (mid-epoch {epoch}, "
                 f"continuing at step {self._resume_step})"
+            )
+        elif self._resume_examples:
+            self._progress = (self.state, epoch, 0, False)
+            rank0_print(
+                f"=> resumed from {path} (mid-epoch {epoch}, elastic: "
+                f"continuing at example offset {self._resume_examples}, "
+                f"remainder re-partitioned over {mesh_lib.process_count()} "
+                "process(es))"
             )
         else:
             self._progress = (self.state, epoch, 0, True)
@@ -2046,7 +2199,14 @@ class Trainer:
         epoch = self._restore_latest()
         if epoch is None:
             raise err
-        self.start_epoch = epoch if self._resume_step else epoch + 1
+        # a mid-fit recovery is not a new segment: fit's resume-record
+        # block already ran, and leaving this set would leak a stale
+        # 'resume' boundary into a LATER fit() on this instance (the
+        # auto_recover history record documents this restore instead)
+        self._elastic_resume = None
+        self.start_epoch = (
+            epoch if (self._resume_step or self._resume_examples) else epoch + 1
+        )
         self._seed_global_step()  # the --profile_steps grid follows the
         #                           restored (replayed) training position
         self._lr_scale *= cfg.recover_lr_factor
@@ -2079,6 +2239,28 @@ class Trainer:
         # through this handle; cleared in the finally below so a direct
         # train_epoch() call outside fit() never logs to a closed file
         self._history = history
+        # elastic observability (docs/resilience.md "Elastic training"):
+        # the current world size is a first-class gauge (segment
+        # boundaries in summarize/tail/pod key off it) and a supervisor-
+        # relaunched child reports WHICH restart it is (the launcher
+        # injects TPU_DIST_ELASTIC_RESTARTS into every relaunched round)
+        import os as _os  # noqa: PLC0415
+
+        counters_lib.set_gauge("elastic.world_size", self.n_data)
+        try:
+            _restarts = int(
+                _os.environ.get("TPU_DIST_ELASTIC_RESTARTS", "0") or 0
+            )
+        except ValueError:
+            _restarts = 0
+        if _restarts:
+            counters_lib.set_gauge("elastic.restarts", _restarts)
+        if self._elastic_resume is not None:
+            # one 'resume' record per resumed segment (schema v7): world
+            # size, reshard flag, re-entry position — the segment-boundary
+            # line obs summarize/tail/pod render
+            history.log("resume", restarts=_restarts, **self._elastic_resume)
+            self._elastic_resume = None
         # re-arm host-span tracing (construction armed it before the
         # resume-path restore; a second fit() on this Trainer re-arms after
         # _export_telemetry disarmed) WITHOUT clearing or re-zeroing — the
@@ -2371,9 +2553,7 @@ class Trainer:
             # exactly); _restore_latest refuses a mismatched resume.
             save(epoch,
                  {**self._ckpt_meta(),
-                  "mid_epoch_step": int(steps_done),
-                  "mid_epoch_batch_size": cfg.batch_size,
-                  "mid_epoch_seed": cfg.seed or 0},
+                  **self._mid_epoch_position(int(steps_done))},
                  f"=> interrupted mid-epoch {epoch} after step "
                  f"{steps_done - 1}; exact snapshot saved — resume continues "
                  f"epoch {epoch} at step {steps_done}")
@@ -2468,6 +2648,7 @@ class Trainer:
             # or the previous epoch's completion) until train_epoch's own
             # publish — every interrupt window reads a consistent position.
             start_step, self._resume_step = self._resume_step, 0
+            start_examples, self._resume_examples = self._resume_examples, 0
             # the epoch-0 blanket trace only when triggered/manual capture
             # does NOT own --profile_dir (two live jax.profiler traces
             # cannot nest)
@@ -2481,7 +2662,10 @@ class Trainer:
                 )
 
                 with trace(cfg.profile_dir):
-                    last = self.train_epoch(epoch, start_step=start_step)
+                    last = self.train_epoch(
+                        epoch, start_step=start_step,
+                        start_examples=start_examples,
+                    )
                 if mesh_lib.is_primary():
                     # the blanket capture gets the same read-back as a
                     # triggered one: attribution record + summary line +
@@ -2493,7 +2677,10 @@ class Trainer:
                         steps=last.get("steps"),
                     )
             else:
-                last = self.train_epoch(epoch, start_step=start_step)
+                last = self.train_epoch(
+                    epoch, start_step=start_step,
+                    start_examples=start_examples,
+                )
             self._in_epoch = False
             # epoch fully trained: one atomic publish flips the position to
             # "complete through epoch" for the eval/save window below
