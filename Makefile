@@ -1,6 +1,6 @@
 # Convenience targets; CI / the driver call the underlying commands directly.
 
-.PHONY: test quick bench csrc clean lint pod-report monitor profile-report elastic-drill
+.PHONY: test quick bench csrc clean lint pod-report monitor profile-report elastic-drill fleet-drill
 
 csrc:
 	$(MAKE) -C tpu_dist/csrc
@@ -41,6 +41,16 @@ profile-report:
 #   make elastic-drill [WORKDIR=/tmp/elastic_drill]
 elastic-drill:
 	python -m tpu_dist.elastic.drill --workdir $(or $(WORKDIR),/tmp/elastic_drill)
+
+# The scale-up + fleet proof, locally: preempt an 8-device run (census
+# caps the relaunch at 4), return the chips (the probe grows it back to
+# 8 with golden-tolerance loss parity), then a 2-run arbitration — the
+# scheduler scrapes real OpenMetrics textfiles and moves chips from the
+# stalled run to the compute-bound one through the live supervised
+# launchers (docs/resilience.md "Scale-up & fleet scheduling"):
+#   make fleet-drill [WORKDIR=/tmp/fleet_drill] [PHASE=all|grow|fleet]
+fleet-drill:
+	python -m tpu_dist.fleet.drill --workdir $(or $(WORKDIR),/tmp/fleet_drill) --phase $(or $(PHASE),all)
 
 # Follow a LIVE run from another terminal:
 #   make monitor LOG=run.jsonl [HB=hb.json]

@@ -67,7 +67,7 @@ _QUICK = (
     "test_metrics.py", "test_collectives.py", "test_sampler.py::",
     "test_ckpt.py", "test_eval.py", "test_bn.py", "test_data.py",
     "test_cli.py", "test_bench_configs.py", "test_golden_trajectory.py",
-    "test_elastic.py",
+    "test_elastic.py", "test_fleet.py",
     "test_tpu_lock.py", "test_regularization.py", "test_remat.py",
     "test_native_pipeline.py", "test_tensorboard.py",
     "test_launch_and_history.py", "test_fused_sgd.py", "test_observability.py",

@@ -1,0 +1,797 @@
+"""Elastic scale-up + fleet scheduling (docs/resilience.md "Scale-up &
+fleet scheduling"): the capacity-probe state machine, the supervisor's
+resize/census-capped/same-size-budget policy, the goodput-aware chip
+arbiter, the in-process 4->8 grow-resume (bit-exact state), the obs
+satellites (GROWN rendering, fleet records, recovery_s attribution), and
+the TD112 traced-noop gate.
+
+World-size changes are driven three ways: pure policy units (no
+processes), stub children through ``cli/launch.py``'s probe-armed
+supervisor (the relaunch mechanics without jax in the loop), and
+in-process by handing the Trainer a smaller mesh first and resuming on
+the full one (full fidelity for the grow state-remap). The multi-phase
+subprocess drill is ``python -m tpu_dist.fleet.drill`` (``make
+fleet-drill``), exercised by a slow-marked test here.
+"""
+
+import json
+import os
+import signal
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_dist.ckpt import checkpoint as ckpt_lib
+from tpu_dist.comm import mesh as mesh_lib
+from tpu_dist.comm.quantize import padded_len
+from tpu_dist.config import TrainConfig
+from tpu_dist.elastic import supervisor as sup
+from tpu_dist.fleet import capacity as capacity_lib
+from tpu_dist.fleet.scheduler import (
+    FLEET_SCHEMA_VERSION,
+    FleetPolicy,
+    FleetScheduler,
+    RunSignals,
+    RunSpec,
+    read_signals,
+)
+from tpu_dist.obs import counters as counters_lib
+from tpu_dist.obs import export as export_lib
+from tpu_dist.resilience import faults, preemption
+from tpu_dist.resilience.preemption import PREEMPTION_EXIT_CODE
+from tpu_dist.resilience.retry import backoff_delays
+from tpu_dist.train.trainer import Trainer, register_model
+from tests.helpers import TinyMLP
+
+# Same probe model as tests/test_elastic.py: L = 49338 ≡ 2 (mod 8), so
+# padded_len(L, 4) = 49340 != 49344 = padded_len(L, 8) — the 4->8 GROW
+# genuinely reshapes the ZeRO-1 flat vectors (and the EF residual row
+# count always changes with the extent).
+register_model(
+    "tiny_mlp_fl", lambda num_classes=10: TinyMLP(num_classes, width=16, in_dim=3072)
+)
+
+L_TINY = 3072 * 16 + 16 + 16 * 10 + 10  # 49338
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    faults.clear()
+    preemption.clear()
+    prev = ckpt_lib.set_io_retries(0)
+    yield
+    faults.clear()
+    preemption.clear()
+    ckpt_lib.set_io_retries(prev)
+
+
+def _cfg(ckpt_dir, **kw):
+    base = dict(
+        dataset="synthetic", model="tiny_mlp_fl", num_classes=10,
+        batch_size=64, epochs=2, steps_per_epoch=3, log_every=50,
+        eval_every=0, save_every=1, synthetic_n=256, seed=0,
+        ckpt_dir=ckpt_dir, num_workers=1,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _mesh(n):
+    return mesh_lib.data_parallel_mesh(jax.devices()[:n])
+
+
+# -- capacity probe: targets + state machine ---------------------------------
+
+
+def test_grow_and_shrink_targets():
+    # grow: largest feasible divisor the capacity staffs, strictly above
+    # current, never past the (max_procs-capped) original
+    assert sup.grow_target(8, 4, available=8) == 8
+    assert sup.grow_target(8, 4, available=7) is None  # 8 not staffable
+    assert sup.grow_target(8, 2, available=5) == 4
+    assert sup.grow_target(8, 4, available=8, max_procs=4) is None
+    assert sup.grow_target(8, 2, available=8, max_procs=4) == 4
+    assert sup.grow_target(8, 8, available=16) is None  # already full
+    assert sup.grow_target(6, 3, available=6) == 6
+    # shrink: largest feasible at/below capacity, strictly below current,
+    # never under the floor — and never "shrink to death"
+    assert sup.shrink_target(8, 8, available=4, min_procs=1) == 4
+    assert sup.shrink_target(8, 8, available=5, min_procs=1) == 4
+    assert sup.shrink_target(8, 4, available=3, min_procs=1) == 2
+    assert sup.shrink_target(8, 4, available=3, min_procs=4) is None
+    assert sup.shrink_target(8, 1, available=0, min_procs=1) is None
+
+
+def test_capacity_probe_interval_grow_cooldown_and_shrink():
+    avail = [8]
+    probe = sup.CapacityProbe(
+        lambda: avail[0], original=8, min_procs=1, interval=10.0,
+    )
+    # first poll only arms the timer — a fresh world settles in peace
+    assert probe.poll(4, now=0.0) is None
+    assert probe.poll(4, now=9.9) is None  # inside the interval
+    assert probe.poll(4, now=10.0) == 8    # grow: capacity staffs 8
+    assert probe.grows == 1
+    # the grow armed the deterministic retry.py cooldown
+    # (cooldown_base defaults to 2*interval): next decision not before
+    # t=10+20, even though the plain interval would re-probe at t=20
+    assert probe.poll(4, now=20.0) is None
+    assert probe.poll(4, now=29.9) is None
+    assert probe.poll(4, now=30.0) == 8
+    assert probe.grows == 2
+    # second cooldown doubles: backoff_delays(2, 20, 600)[1] = 40
+    assert backoff_delays(2, 20.0, 600.0)[1] == 40.0
+    assert probe.poll(4, now=50.0) is None
+    assert probe.poll(4, now=70.0) == 8
+    # shrinks (donations) are NOT cooled down: the chips are gone
+    avail[0] = 2
+    assert probe.poll(4, now=80.0) == 2
+    avail[0] = 1
+    assert probe.poll(2, now=90.0) == 1
+    # ...and a shrink RESETS the grow streak: the next donate->receive
+    # cycle starts the cooldown ladder from the base again, instead of
+    # paying 2^k of the run's lifetime grow count — while the cooldown
+    # ARMED by the last grow still stands (anti-flap)
+    avail[0] = 8
+    assert probe.poll(2, now=100.0) is None  # standing cooldown holds
+    assert probe.poll(2, now=150.0) == 8     # it expires, grow fires
+    assert probe.grows == 1                  # fresh streak, not 4
+    assert probe.poll(2, now=160.0) is None  # base cooldown (20s), not 160s
+    assert probe.poll(2, now=170.0) == 8
+    # an unanswerable census is a no-op, never a resize
+    avail2 = sup.CapacityProbe(lambda: None, original=8, interval=1.0)
+    assert avail2.poll(4, now=0.0) is None
+    assert avail2.poll(4, now=5.0) is None
+
+
+def test_capacity_probe_reset_timer_and_available():
+    probe = sup.CapacityProbe(lambda: 8, original=8, interval=10.0)
+    assert probe.poll(4, now=0.0) is None
+    probe.reset_timer(now=25.0)  # a new round spawned at t=25
+    assert probe.poll(4, now=30.0) is None  # its interval restarted
+    assert probe.poll(4, now=35.0) == 8
+    assert probe.available() == 8
+
+    def boom():
+        raise OSError("census backend gone")
+
+    assert sup.CapacityProbe(boom, original=8).available() is None
+
+
+def test_make_census_resolution_order(tmp_path):
+    cap = str(tmp_path / "allocation")
+    # missing file -> env -> default
+    census = capacity_lib.make_census(cap, default=8, env={})
+    assert census() == 8
+    census = capacity_lib.make_census(
+        cap, default=8, env={capacity_lib.CAPACITY_ENV: "6"}
+    )
+    assert census() == 6
+    capacity_lib.write_allocation(cap, 4)
+    assert census() == 4  # the file wins once it exists
+    assert capacity_lib.read_allocation(cap) == 4
+    # torn/garbage file degrades to the fallbacks, never raises
+    with open(cap, "w") as f:
+        f.write("not-a-number")
+    assert census() == 6
+    assert capacity_lib.read_allocation(str(tmp_path / "missing")) is None
+    # garbage ENV values degrade to the default too — "--4" passes an
+    # isdigit-after-lstrip check but must not crash the probe mid-run
+    for bad in ("--4", "+-5", "4.5", "", "  ", "x9"):
+        c = capacity_lib.make_census(
+            None, default=8, env={capacity_lib.CAPACITY_ENV: bad}
+        )
+        assert c() == 8, bad
+    c = capacity_lib.make_census(
+        None, default=8, env={capacity_lib.CAPACITY_ENV: "+6"}
+    )
+    assert c() == 6
+
+
+# -- supervisor: resize rounds, census cap, same-size budget -----------------
+
+
+def test_supervise_resize_rounds_do_not_burn_budget():
+    calls = []
+    sleeps = []
+
+    def rounds(n, idx):
+        calls.append((n, idx))
+        if idx == 0:  # the scheduler took half our chips: donate
+            return sup.RoundResult(
+                PREEMPTION_EXIT_CODE, {i: 75 for i in range(n)}, resize_to=4
+            )
+        if idx == 1:  # capacity returned: grow back
+            return sup.RoundResult(
+                PREEMPTION_EXIT_CODE, {i: 75 for i in range(n)}, resize_to=8
+            )
+        return sup.RoundResult(0, {i: 0 for i in range(n)})
+
+    rc = sup.supervise(
+        rounds, nproc=8, min_procs=1, max_restarts=0,  # NO failure budget
+        sleep=sleeps.append,
+    )
+    assert rc == 0
+    assert calls == [(8, 0), (4, 1), (8, 2)]
+    assert sleeps == []  # resizes wait no failure backoff
+
+    # the launcher's own SIGTERM outranks a pending resize
+    assert sup.supervise(
+        lambda n, i: sup.RoundResult(75, {0: 75}, resize_to=8),
+        nproc=4, min_procs=1, max_restarts=5, sleep=lambda _s: None,
+        should_continue=lambda: False,
+    ) == 75
+
+
+def test_supervise_census_caps_failure_relaunch():
+    calls = []
+    probe = sup.CapacityProbe(lambda: 4, original=8, interval=1.0)
+
+    def rounds(n, idx):
+        calls.append((n, idx))
+        if idx == 0:  # whole-pod preemption, but the census says half
+            # the chips are gone — same-size retry would hang forever
+            return sup.RoundResult(75, {i: 75 for i in range(n)})
+        return sup.RoundResult(0, {i: 0 for i in range(n)})
+
+    rc = sup.supervise(
+        rounds, nproc=8, min_procs=1, max_restarts=3,
+        sleep=lambda _s: None, probe=probe,
+    )
+    assert rc == 0
+    assert calls == [(8, 0), (4, 1)]
+
+    # census below the floor: give up with the round's code
+    probe2 = sup.CapacityProbe(lambda: 1, original=8, interval=1.0)
+    assert sup.supervise(
+        lambda n, i: sup.RoundResult(75, {j: 75 for j in range(n)}),
+        nproc=8, min_procs=4, max_restarts=3, sleep=lambda _s: None,
+        probe=probe2,
+    ) == 75
+
+    # a census-capped size change starts a FRESH same-size streak: with
+    # same_size_retries=1 the run gets one full retry at 4 before the
+    # step-down to 2, even though the 8->4 cap already spent one
+    calls2 = []
+    probe3 = sup.CapacityProbe(lambda: 4, original=8, interval=1.0)
+    sup.supervise(
+        lambda n, i: (calls2.append(n) or
+                      sup.RoundResult(75, {j: 75 for j in range(n)})),
+        nproc=8, min_procs=2, max_restarts=4, sleep=lambda _s: None,
+        probe=probe3, same_size_retries=1,
+    )
+    assert calls2 == [8, 4, 4, 2, 2]
+
+
+def test_supervise_same_size_retry_budget_steps_down():
+    calls = []
+
+    def rounds(n, idx):
+        calls.append((n, idx))
+        return sup.RoundResult(75, {i: 75 for i in range(n)})
+
+    said = []
+    rc = sup.supervise(
+        rounds, nproc=8, min_procs=2, max_restarts=4,
+        sleep=lambda _s: None, announce=said.append, same_size_retries=2,
+    )
+    # 2 same-size retries at 8, then step down to 4, then its own budget
+    assert rc == 75
+    assert [n for n, _ in calls] == [8, 8, 8, 4, 4]
+    assert any("stepping down to 4" in m for m in said)
+
+    # at the floor there is nowhere to step down: keep retrying same size
+    calls.clear()
+    sup.supervise(
+        rounds, nproc=4, min_procs=4, max_restarts=3,
+        sleep=lambda _s: None, same_size_retries=1,
+    )
+    assert [n for n, _ in calls] == [4, 4, 4, 4]
+
+    # a real loss resets the same-size streak (census path still rules)
+    seq = iter([
+        sup.RoundResult(75, {i: 75 for i in range(8)}),          # whole pod
+        sup.RoundResult(75, {0: 75, 1: -signal.SIGKILL} |
+                        {i: 75 for i in range(2, 8)}),           # 1 lost
+        sup.RoundResult(0, {i: 0 for i in range(4)}),
+    ])
+    calls.clear()
+    rc = sup.supervise(
+        lambda n, i: (calls.append((n, i)) or next(seq)),
+        nproc=8, min_procs=1, max_restarts=4, sleep=lambda _s: None,
+        same_size_retries=2,
+    )
+    assert rc == 0
+    assert [n for n, _ in calls] == [8, 8, 4]
+
+
+# -- scheduler: policy units on synthetic signals ----------------------------
+
+
+def _sig(run, stall, alerts=(), alive=None):
+    return RunSignals(
+        run=run, data_stall_frac=stall, goodput_frac=0.5, mfu=0.3,
+        active_alerts=tuple(alerts), alive=alive,
+    )
+
+
+def _fleet(**kw):
+    args = dict(
+        runs=[RunSpec("a", 8, min_procs=2), RunSpec("b", 8, min_procs=2)],
+        allocations={"a": 8, "b": 4},
+        total_chips=12,
+    )
+    args.update(kw)
+    return FleetScheduler(**args)
+
+
+def test_scheduler_donates_then_grants_one_tick_later():
+    """The two-phase move: a donation banks the chips as PENDING (the
+    donor needs its checkpoint/relaunch window to vacate them — granting
+    in the same instant would oversubscribe the pool); the recipient is
+    granted from the matured free pool at the NEXT tick. At no point do
+    the written allocations plus the free pool exceed the chips that
+    are actually vacant."""
+    s = _fleet()
+    sig = {"a": _sig("a", 0.62), "b": _sig("b", 0.02)}
+    ds = s.decide(0, sig)
+    assert len(ds) == 1
+    d = ds[0]
+    assert d["kind"] == "fleet" and d["action"] == "donate"
+    assert d["donor"] == "a" and d["recipient"] is None
+    assert d["for_run"] == "b"
+    assert d["alloc_after"] == {"a": 4, "b": 4}  # b NOT grown yet
+    assert d["chips"] == 4 and d["pending_after"] == 4
+    # auditable: the decision carries the signals that justified it
+    assert d["inputs"]["a"]["data_stall_frac"] == 0.62
+    assert d["inputs"]["b"]["data_stall_frac"] == 0.02
+    assert "data-stalled donates" in d["reason"]
+    # deterministic: same state + same signals => same decision
+    assert s.decide(0, sig) == ds
+    s.apply(d, 0)
+    assert s.alloc == {"a": 4, "b": 4}
+    assert s.pending == 4 and s.free == 0
+    # never oversubscribed: allocations + vacant chips <= total
+    assert sum(s.alloc.values()) + s.pending + s.free <= s.total_chips + 4
+    assert sum(s.alloc.values()) + s.free <= s.total_chips
+    # still tick 0: the banked chips are NOT grantable yet
+    s.mature_pending(0)
+    assert s.decide(0, sig) == []
+    # next tick: they mature and the starved recipient is granted
+    s.mature_pending(1)
+    assert s.pending == 0 and s.free == 4
+    [g] = s.decide(1, sig)
+    assert g["action"] == "grant"
+    assert g["donor"] is None and g["recipient"] == "b"
+    assert g["alloc_after"] == {"a": 4, "b": 8} and g["free_after"] == 0
+    s.apply(g, 1)
+    assert s.alloc == {"a": 4, "b": 8}
+
+
+def test_scheduler_thresholds_and_vetoes():
+    # below the donate threshold: nobody moves
+    s = _fleet()
+    assert s.decide(0, {"a": _sig("a", 0.39), "b": _sig("b", 0.02)}) == []
+    # recipient not compute-bound enough: no move
+    assert s.decide(0, {"a": _sig("a", 0.62), "b": _sig("b", 0.12)}) == []
+    # alert-veto: a firing run never receives chips
+    assert s.decide(0, {
+        "a": _sig("a", 0.62), "b": _sig("b", 0.02, alerts=("grad_norm_high",)),
+    }) == []
+    # dead heartbeat vetoes both roles
+    assert s.decide(0, {
+        "a": _sig("a", 0.62, alive=False), "b": _sig("b", 0.02),
+    }) == []
+    assert s.decide(0, {
+        "a": _sig("a", 0.62), "b": _sig("b", 0.02, alive=False),
+    }) == []
+    # absent signals make a run ineligible (never default to a number)
+    assert s.decide(0, {"a": _sig("a", None), "b": _sig("b", 0.02)}) == []
+    assert s.decide(0, {"a": _sig("a", 0.62)}) == []
+
+
+def test_scheduler_never_below_min_procs():
+    s = _fleet(
+        runs=[RunSpec("a", 8, min_procs=8), RunSpec("b", 8, min_procs=2)],
+        allocations={"a": 8, "b": 4}, total_chips=12,
+    )
+    # a's floor IS its allocation: it cannot donate no matter how stalled
+    assert s.decide(0, {"a": _sig("a", 0.99), "b": _sig("b", 0.0)}) == []
+
+
+def test_scheduler_cooldown_and_hysteresis():
+    s = _fleet(policy=FleetPolicy(move_cooldown=2, hysteresis=0.05))
+    sig = {"a": _sig("a", 0.62), "b": _sig("b", 0.02)}
+    [d] = s.step(0, sig)  # donate: a 8->4, 4 chips pending
+    assert d["action"] == "donate" and s.alloc == {"a": 4, "b": 4}
+    [g] = s.step(1, sig)  # matured: grant b 4->8
+    assert g["action"] == "grant" and s.alloc == {"a": 4, "b": 8}
+    # cooldown: a (moved at 0) sits out through tick 2, b (moved at 1)
+    # through tick 3
+    flipped = {"a": _sig("a", 0.02), "b": _sig("b", 0.62)}
+    assert s.step(2, flipped) == []
+    assert s.step(3, flipped) == []
+    # after the cooldown, hysteresis gates the REVERSAL: b (which just
+    # received) must breach donate+hysteresis to donate back, and a
+    # (which just donated) must be under receive-hysteresis to receive
+    nearly = {"a": _sig("a", 0.08), "b": _sig("b", 0.43)}
+    assert s.step(4, nearly) == []  # 0.43 < 0.40+0.05; 0.08 > 0.10-0.05
+    decisively = {"a": _sig("a", 0.03), "b": _sig("b", 0.62)}
+    [d2] = s.step(4, decisively)
+    assert d2["action"] == "donate"
+    assert d2["donor"] == "b" and d2["for_run"] == "a"
+
+
+def test_scheduler_free_pool_grow_needs_no_donor(tmp_path):
+    s = FleetScheduler(
+        [RunSpec("a", 8, min_procs=2)],
+        fleet_dir=str(tmp_path), allocations={"a": 4}, total_chips=8,
+    )
+    assert s.free == 4
+    [d] = s.step(0, {"a": _sig("a", 0.02)}, ts=123.0)
+    assert d["donor"] is None and d["recipient"] == "a"
+    assert d["alloc_after"] == {"a": 8} and d["free_after"] == 0
+    assert "free pool" in d["reason"]
+    # the actuator wrote the allocation file and the audit record
+    assert capacity_lib.read_allocation(s.allocation_path("a")) == 8
+    recs = [json.loads(l) for l in open(s.history_path())]
+    assert recs[0]["kind"] == "fleet" and recs[0]["ts"] == 123.0
+    assert recs[0]["schema_version"] == FLEET_SCHEMA_VERSION
+
+
+def test_fleet_schema_version_pinned_to_history():
+    # scheduler.py keeps a literal (it must stay jax-free); this pin is
+    # what stops the two from drifting silently
+    from tpu_dist.metrics.history import SCHEMA_VERSION
+
+    assert FLEET_SCHEMA_VERSION == SCHEMA_VERSION
+
+
+def test_scheduler_rejects_bad_configs():
+    with pytest.raises(ValueError, match="feasible"):
+        FleetScheduler([RunSpec("a", 8)], allocations={"a": 5})
+    with pytest.raises(ValueError, match="total_chips"):
+        FleetScheduler([RunSpec("a", 8)], allocations={"a": 8}, total_chips=4)
+    with pytest.raises(ValueError, match="duplicate"):
+        FleetScheduler([RunSpec("a", 8), RunSpec("a", 4)])
+    with pytest.raises(ValueError, match="receive_stall_frac"):
+        FleetPolicy(donate_stall_frac=0.1, receive_stall_frac=0.4)
+    with pytest.raises(ValueError, match="min_procs"):
+        RunSpec("a", 4, min_procs=5)
+
+
+def test_read_signals_scrapes_a_real_exposition(tmp_path):
+    prom = str(tmp_path / "metrics.prom")
+    with open(prom, "w") as f:
+        f.write(export_lib.render(
+            {
+                "train.data_stall_frac": 0.45,
+                "goodput.goodput_frac": 0.61,
+                "train.mfu": 0.33,
+                "train.epoch": 3,
+            },
+            labeled={"alert_active": {"stall_high": 1, "mfu_low": 0}},
+        ))
+    sig = read_signals("r0", prom)
+    assert sig.data_stall_frac == 0.45
+    assert sig.goodput_frac == 0.61
+    assert sig.mfu == 0.33
+    assert sig.epoch == 3
+    assert sig.active_alerts == ("stall_high",)  # 0-valued gauge ignored
+    assert sig.alive is None  # no heartbeat source configured
+    # absent exposition degrades to all-None, never raises
+    empty = read_signals("r1", str(tmp_path / "missing.prom"))
+    assert empty.data_stall_frac is None and empty.active_alerts == ()
+
+
+def test_scheduler_exposition_uses_run_label(tmp_path):
+    s = _fleet()
+    text = s.exposition()
+    assert 'tpu_dist_fleet_allocation{run="a"} 8' in text
+    assert 'tpu_dist_fleet_allocation{run="b"} 4' in text
+    assert "tpu_dist_fleet_decisions 0" in text
+    path = str(tmp_path / "fleet.prom")
+    s.write_exposition(path)
+    vals = export_lib.scrape(textfile=path)
+    assert vals['tpu_dist_fleet_allocation{run="b"}'] == 4.0
+    # the default labeled family still renders rule= (alerts unchanged)
+    assert 'alert_active{rule="x"}' in export_lib.render(
+        {}, labeled={"alert_active": {"x": 1}}
+    )
+    # gauges for the scheduler's own registry snapshot
+    assert counters_lib.snapshot()["fleet.allocation.a"] == 8
+
+
+# -- launcher e2e: probe-driven resize with stub children --------------------
+
+
+def test_launcher_probe_resize_stub_children(tmp_path):
+    """cli/launch.py e2e (no jax): the census is authoritative from
+    birth — a 4-proc submission whose allocation says 2 launches round 0
+    at 2 (never on another run's chips); capacity returns mid-round and
+    the probe grows it to 4 with --resume — restart budget untouched at
+    every step."""
+    from tpu_dist.cli.launch import main as launch_main
+
+    marker = str(tmp_path / "worlds.txt")
+    cap = str(tmp_path / "allocation")
+    capacity_lib.write_allocation(cap, 2)
+    child = (
+        "import os, signal, sys, time\n"
+        "argv = sys.argv\n"
+        "n = int(argv[argv.index('--num_processes') + 1])\n"
+        "rank = int(argv[argv.index('--process_id') + 1])\n"
+        "resume = '--resume' in argv\n"
+        "if rank == 0:\n"
+        f"    open({marker!r}, 'a').write(\n"
+        "        f\"{n} {int(resume)} \"\n"
+        "        f\"{os.environ.get('TPU_DIST_ELASTIC_RESTARTS')}\\n\")\n"
+        "signal.signal(signal.SIGTERM, lambda *a: sys.exit(75))\n"
+        "if resume and n == 4:\n"
+        "    sys.exit(0)\n"  # grown to full size: run completes
+        "if n == 2 and rank == 0:\n"
+        "    time.sleep(0.1)\n"
+        f"    open({cap!r} + '.t', 'w').write('4')\n"
+        f"    os.replace({cap!r} + '.t', {cap!r})\n"
+        "time.sleep(60)\n"
+    )
+    rc = launch_main([
+        "--nproc", "4", "--elastic_min_procs", "1",
+        "--elastic_max_restarts", "0",  # resizes need NO failure budget
+        "--elastic_backoff", "0.01", "--elastic_probe_interval", "0.2",
+        "--elastic_capacity_file", cap, "--",
+        sys.executable, "-c", child,
+    ])
+    assert rc == 0
+    lines = [l.split() for l in open(marker).read().splitlines()]
+    # round 0 at the GRANTED 2 (fresh start, no --resume), grown to 4
+    assert lines == [["2", "0", "0"], ["4", "1", "1"]]
+
+
+def test_launcher_refuses_start_below_the_floor(tmp_path):
+    """A census granting fewer procs than --elastic_min_procs at launch
+    is a loud refusal, not a run squatting on someone else's chips."""
+    from tpu_dist.cli.launch import main as launch_main
+
+    cap = str(tmp_path / "allocation")
+    capacity_lib.write_allocation(cap, 1)
+    rc = launch_main([
+        "--nproc", "4", "--elastic_min_procs", "2",
+        "--elastic_probe_interval", "0.2",
+        "--elastic_capacity_file", cap, "--",
+        sys.executable, "-c", "import sys; sys.exit(0)",
+    ])
+    assert rc == 1
+
+
+# -- trainer e2e: in-process 4 -> 8 grow-resume ------------------------------
+
+
+def test_trainer_grow_resume_zero1_ef_is_bit_exact(tmp_path):
+    """The scale-up tentpole at the state layer: a ZeRO-1 + int8_ef run
+    saved on a 4-device mesh resumes onto the full 8-device mesh —
+    params bit-identical, ZeRO-1 momentum's logical prefix bit-identical
+    with a zero tail at the LARGER padded length, EF aggregate preserved,
+    ``elastic.grows`` counted — and keeps training at the new extent."""
+    d = str(tmp_path)
+    log = os.path.join(d, "run.jsonl")
+    cfg = _cfg(d, shard_weight_update=True, grad_compression="int8_ef",
+               log_file=log)
+    t = Trainer(cfg, mesh=_mesh(4))
+    t.fit()
+    ck = ckpt_lib.latest_checkpoint(d)
+    assert ck is not None and ck[1] == 1
+    with np.load(ck[0]) as z:
+        saved = {k: np.array(z[k]) for k in z.files if k != "__meta__"}
+    meta = ckpt_lib.read_meta(ck[0])
+    assert meta["elastic"] == {"dp": 4, "procs": 1, "params_len": L_TINY}
+    old_r1 = saved["['ef']['r1']"].reshape(4, padded_len(L_TINY, 4))
+
+    t2 = Trainer(cfg.replace(resume=True))  # default mesh: all 8 devices
+    assert t2.start_epoch == 2
+    assert counters_lib.get("resume.resharded") == 1
+    assert counters_lib.get("elastic.grows") == 1
+    # params: world-size-independent, bit-identical
+    for (path_a, a) in jax.tree_util.tree_flatten_with_path(t2.state.params)[0]:
+        key = jax.tree_util.keystr(path_a)
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a)), saved[f"['params']{key}"]
+        )
+    # ZeRO-1 momentum: logical prefix bit-identical, grown tail zero
+    mom = np.asarray(jax.device_get(t2.state.opt_state))
+    assert mom.shape == (padded_len(L_TINY, 8),)
+    np.testing.assert_array_equal(mom[:L_TINY], saved["['opt_state']"][:L_TINY])
+    assert not mom[L_TINY:].any()
+    # EF r1: aggregate residual preserved exactly across MORE replica rows
+    r1 = np.asarray(jax.device_get(t2.state.ef["r1"])).reshape(
+        8, padded_len(L_TINY, 8)
+    )
+    np.testing.assert_array_equal(
+        r1.sum(axis=0, dtype=np.float32)[:L_TINY],
+        old_r1[:, :L_TINY].sum(axis=0, dtype=np.float32),
+    )
+    # ...and the grown trainer actually trains an epoch at dp=8
+    last = t2.fit(3)
+    assert np.isfinite(last["loss"]) and last["steps"] == 3
+    recs = [json.loads(l) for l in open(log)]
+    resumes = [r for r in recs if r.get("kind") == "resume"]
+    assert resumes and resumes[-1]["resharded"] is True
+    assert resumes[-1]["dp"] == 8 and resumes[-1]["prev_dp"] == 4
+    assert counters_lib.snapshot()["elastic.world_size"] == 8
+
+
+def test_trainer_grow_without_remappable_leaves_still_counts(tmp_path):
+    """A run with NO dp-extent-dependent leaves (plain per-leaf momentum,
+    no ZeRO-1/EF) grows 4->8 with zero remapped leaves — resharded stays
+    False, but it still GREW: ``elastic.grows`` must count it and the
+    resume record must carry the world change (which is also what routes
+    the relaunch gap to recovery_s offline)."""
+    d = str(tmp_path)
+    log = os.path.join(d, "run.jsonl")
+    cfg = _cfg(d, epochs=1, log_file=log)
+    Trainer(cfg, mesh=_mesh(4)).fit()
+    t2 = Trainer(cfg.replace(resume=True))  # default mesh: 8 devices
+    assert counters_lib.get("elastic.grows") == 1
+    assert counters_lib.get("resume.resharded") == 0  # nothing re-laid
+    t2.fit(2)
+    recs = [json.loads(l) for l in open(log)]
+    resumes = [r for r in recs if r.get("kind") == "resume"]
+    assert resumes and resumes[-1]["prev_dp"] == 4
+    assert resumes[-1]["dp"] == 8 and resumes[-1]["resharded"] is False
+
+
+# -- observability satellites ------------------------------------------------
+
+
+def _resume_rec(run_id, ts, rel_s, **kw):
+    rec = {"kind": "resume", "run_id": run_id, "ts": ts, "rel_s": rel_s,
+           "schema_version": 8}
+    rec.update(kw)
+    return rec
+
+
+def _fleet_rec(**kw):
+    rec = {"kind": "fleet", "schema_version": 8, "tick": 0,
+           "action": "move", "donor": "a", "recipient": "b", "chips": 4,
+           "alloc_before": {"a": 8, "b": 4}, "alloc_after": {"a": 4, "b": 8},
+           "reason": "a 62% data-stalled donates to compute-bound b",
+           "inputs": {"a": {"data_stall_frac": 0.62}}, "ts": 5.0,
+           "run_id": "sched"}
+    rec.update(kw)
+    return rec
+
+
+def test_run_ledger_charges_grow_gap_to_recovery():
+    from tpu_dist.obs import goodput
+
+    def gp(run, ts, rel, **kw):
+        rec = {"kind": "goodput", "run_id": run, "ts": ts, "rel_s": rel}
+        rec.update(kw)
+        return rec
+
+    records = [
+        gp("a", 10.0, 5.0, final=True, productive_s=4.0, elapsed_s=5.0,
+           goodput_frac=0.8),
+        # 6s checkpoint->relaunch gap; the new segment opens with a GROW
+        # resume whose remap happened to re-lay nothing (resharded False,
+        # world changed): a voluntary resize must never inflate preempt_s
+        _resume_rec("b", 16.0, 0.0, epoch=1, dp=8, prev_dp=4,
+                    resharded=False),
+        gp("b", 20.0, 4.0, final=True, productive_s=3.0, elapsed_s=4.0,
+           goodput_frac=0.75),
+    ]
+    led = goodput.run_ledger(records)
+    assert led["recovery_s"] == pytest.approx(6.0)
+    assert led["preempt_s"] == pytest.approx(0.0)
+    # a same-size restart still charges preempt_s
+    records[1] = _resume_rec("b", 16.0, 0.0, epoch=1, dp=8, prev_dp=8,
+                             resharded=False)
+    led = goodput.run_ledger(records)
+    assert led["preempt_s"] == pytest.approx(6.0)
+    assert led["recovery_s"] == pytest.approx(0.0)
+
+
+def test_tail_renders_grown_and_fleet_events():
+    from tpu_dist.obs.tail import TailState
+
+    st = TailState()
+    st.add([
+        _resume_rec("a", 1.0, 0.0, epoch=1, world=8, dp=8, prev_dp=4,
+                    resharded=True, restarts=2),
+        _fleet_rec(),
+    ])
+    assert any("GROWN from dp=4" in e for e in st.events)
+    assert not any("RESHARDED" in e for e in st.events)
+    assert any(
+        "fleet: a -> b (4 chip(s))" in e and "data-stalled" in e
+        for e in st.events
+    )
+    # the shrink direction still reads RESHARDED
+    st2 = TailState()
+    st2.add([_resume_rec("a", 1.0, 0.0, epoch=1, world=4, dp=4, prev_dp=8,
+                         resharded=True)])
+    assert any("RESHARDED from dp=8" in e for e in st2.events)
+
+
+def test_summarize_renders_grow_segments_and_fleet_decisions():
+    from tpu_dist.obs.summarize import format_text, summarize
+
+    records = [
+        {"kind": "train_epoch", "epoch": 0, "run_id": "a", "ts": 1.0,
+         "rel_s": 1.0, "schema_version": 8, "epoch_time": 1.0,
+         "images_per_sec": 50.0, "loss": 2.0},
+        _resume_rec("b", 10.0, 0.5, epoch=1, world=8, dp=8, prev_dp=4,
+                    resharded=True, restarts=2),
+        _fleet_rec(run_id="b", ts=11.0),
+        {"kind": "train_epoch", "epoch": 1, "run_id": "b", "ts": 12.0,
+         "rel_s": 1.5, "schema_version": 8, "epoch_time": 1.0,
+         "images_per_sec": 100.0, "loss": 1.5},
+    ]
+    rep = summarize(records)
+    assert rep["world_sizes"] == [4, 8]
+    assert rep["fleet_decisions"][0]["recipient"] == "b"
+    assert rep["fleet_decisions"][0]["inputs"]["a"]["data_stall_frac"] == 0.62
+    assert not rep["skipped_kinds"]  # 'fleet' is a KNOWN kind now
+    text = format_text(rep)
+    assert "GROWN from dp=4" in text
+    assert "world size changed mid-run (elastic): dp 4 -> 8" in text
+    assert "fleet: tick 0: a -> b (4 chip(s))" in text
+    assert "[alloc a:8->4, b:4->8]" in text
+
+
+def test_pod_report_surfaces_grows_and_fleet_decisions():
+    from tpu_dist.obs.aggregate import format_text, pod_report
+
+    records = [
+        _resume_rec("a", 1.0, 0.0, epoch=0, world=4, dp=4, prev_dp=8,
+                    resharded=True),
+        _resume_rec("b", 9.0, 0.0, epoch=1, world=8, dp=8, prev_dp=4,
+                    resharded=True),
+        _fleet_rec(run_id="b", ts=10.0),
+    ]
+    rep = pod_report([("host0", records)])
+    assert rep["hosts"][0]["world_sizes"] == [8, 4, 8]
+    assert rep["hosts"][0]["fleet_decisions"]
+    text = format_text(rep)
+    assert "1 grow(s)" in text
+    assert "fleet (host0) tick 0: a -> b (4 chip(s))" in text
+
+
+# -- TD112: grow-resume is invisible to the compiled program -----------------
+
+
+def test_td112_registered_and_gate_passes():
+    from tpu_dist.analysis.jaxpr_audit import elastic_grow_noop_violations
+    from tpu_dist.analysis.rules import RULES
+
+    assert "TD112" in RULES and RULES["TD112"].name == "elastic-grow-not-noop"
+    assert elastic_grow_noop_violations() == []
+
+
+# -- the full subprocess drill (make fleet-drill) ----------------------------
+
+
+def test_fleet_drill_fleet_phase(tmp_path):
+    """The arbitration half of the drill runs in tier-1: two supervised
+    stub runs, a real scrape, a real decision, real relaunches — no jax
+    subprocesses."""
+    from tpu_dist.fleet.drill import main as drill_main
+
+    assert drill_main([
+        "--workdir", str(tmp_path), "--phase", "fleet",
+    ]) == 0
+
+
+@pytest.mark.slow  # four subprocess training phases (compiles included):
+# excluded from the timed tier-1 gate; gates in the CI fleet step
+def test_fleet_drill_grow_phase(tmp_path):
+    from tpu_dist.fleet.drill import main as drill_main
+
+    assert drill_main([
+        "--workdir", str(tmp_path), "--phase", "grow",
+        "--devices", "8", "--shrink_to", "4", "--model", "vit_tiny",
+        "--epochs", "3", "--steps_per_epoch", "3", "--batch_size", "32",
+        "--kill_epoch", "1", "--kill_step", "1",
+    ]) == 0

@@ -958,26 +958,21 @@ def live_export_noop_violations(mesh=None) -> list[Violation]:
     return out
 
 
-def elastic_resume_noop_violations(mesh=None) -> list[Violation]:
-    """TD111: elastic resume must be invisible to the compiled program —
-    a trainer whose state was RESTORED from a checkpoint written at a
-    different dp extent (and remapped by ``tpu_dist/elastic/remap.py``)
-    must trace the byte-identical step a fresh-start trainer at the same
-    (new) world size traces.
+def _elastic_noop_probe(mesh=None, *, grow: bool):
+    """Shared TD111/TD112 probe machinery: build the OLD world's ZeRO-1 +
+    error-feedback state host-side (flat momentum padded for ``n_old``
+    devices, ``n_old`` residual rows), save a real checkpoint, restore it
+    through the elastic remapper onto a template laid out for ``n_new``
+    devices, and trace the ``n_new`` train step with the fresh-start
+    state and with the restored one. ``grow=False`` is the TD111 shrink
+    direction (``n_old = all devices, n_new = n_old // 2``); ``grow=True``
+    mirrors it (``n_old = n // 2, n_new = n`` — the path a probe-triggered
+    scale-up or fleet chip receipt resumes through).
 
-    The probe builds the old world's ZeRO-1 + error-feedback state
-    host-side (momentum padded for ``n_old`` devices, ``n_old`` residual
-    rows), saves a real checkpoint, restores it through the elastic
-    remapper onto a template laid out for ``n_new = n_old // 2`` devices,
-    and traces the ``n_new`` train step with the fresh state and with the
-    restored one. Any remap sloppiness — a float64 leak from numpy
-    padding, a wrong flat length, a dtype drift — changes the avals and
-    trips this; and the probe asserts the remapper actually FIRED when
-    the two extents produce different padded lengths (a vacuous
-    comparison is itself a violation). The probe model's raveled length
-    is congruent to 4 mod 8 precisely so the 8-to-4 shrink changes the
-    padded layouts (the default audit MLP's 480 divides every mesh
-    width, which would make the remap a no-op)."""
+    The probe model's raveled length is congruent to 4 mod 8 precisely so
+    the extent change reshapes the padded flat layouts (the default audit
+    MLP's 480 divides every mesh width, which would make the remap a
+    no-op). Returns ``(layouts_differ, remapper_fired, identical)``."""
     import shutil
     import tempfile
 
@@ -1001,14 +996,18 @@ def elastic_resume_noop_violations(mesh=None) -> list[Violation]:
     devs = (
         list(mesh.devices.ravel()) if mesh is not None else jax.devices()
     )
-    n_old = len(devs)
-    n_new = max(1, n_old // 2)
+    if grow:
+        n_new = len(devs)
+        n_old = max(1, n_new // 2)
+    else:
+        n_old = len(devs)
+        n_new = max(1, n_old // 2)
     mesh_new = mesh_lib.data_parallel_mesh(devs[:n_new])
 
     class _ElasticMLP(_AuditMLP):
-        # classes=12 → L = 12*16 + 16 + 16*12 + 12 = 412 ≡ 4 (mod 8):
+        # classes=12 -> L = 12*16 + 16 + 16*12 + 12 = 412 == 4 (mod 8):
         # padded_len(412, 8) = 416 != 412 = padded_len(412, 4) — the
-        # shrink genuinely reshapes the flat layouts
+        # extent change genuinely reshapes the flat layouts
         classes = 12
 
     model = _ElasticMLP()
@@ -1024,8 +1023,7 @@ def elastic_resume_noop_violations(mesh=None) -> list[Violation]:
     st_old = TrainState(
         params_host, {}, mom_old, np.asarray(0, np.int32), ef_old
     )
-    tmp = tempfile.mkdtemp(prefix="td111_elastic_")
-    out: list[Violation] = []
+    tmp = tempfile.mkdtemp(prefix="td112_grow_" if grow else "td111_elastic_")
     try:
         path = ckpt_lib.save(tmp, st_old, epoch=0)
         opt = SGD(momentum=0.9, weight_decay=1e-4)
@@ -1052,7 +1050,23 @@ def elastic_resume_noop_violations(mesh=None) -> list[Violation]:
     layouts_differ = (
         padded_len(L, n_old) != padded_len(L, n_new) or n_old != n_new
     )
-    if layouts_differ and not remapper.used:
+    return layouts_differ, bool(remapper.used), base == resumed
+
+
+def elastic_resume_noop_violations(mesh=None) -> list[Violation]:
+    """TD111: elastic resume must be invisible to the compiled program —
+    a trainer whose state was RESTORED from a checkpoint written at a
+    different dp extent (and remapped by ``tpu_dist/elastic/remap.py``)
+    must trace the byte-identical step a fresh-start trainer at the same
+    (new) world size traces. Any remap sloppiness — a float64 leak from
+    numpy padding, a wrong flat length, a dtype drift — changes the
+    avals and trips this; and the probe asserts the remapper actually
+    FIRED when the two extents produce different padded lengths (a
+    vacuous comparison is itself a violation). Probe machinery shared
+    with TD112: :func:`_elastic_noop_probe` (the shrink direction)."""
+    layouts_differ, fired, identical = _elastic_noop_probe(mesh, grow=False)
+    out: list[Violation] = []
+    if layouts_differ and not fired:
         out.append(
             Violation(
                 "TD111",
@@ -1065,7 +1079,7 @@ def elastic_resume_noop_violations(mesh=None) -> list[Violation]:
                 snippet="elastic remapper did not fire",
             )
         )
-    if base != resumed:
+    if not identical:
         out.append(
             Violation(
                 "TD111",
@@ -1083,6 +1097,52 @@ def elastic_resume_noop_violations(mesh=None) -> list[Violation]:
     return out
 
 
+def elastic_grow_noop_violations(mesh=None) -> list[Violation]:
+    """TD112: the grow mirror of TD111 — a trainer whose state was
+    RESTORED from a checkpoint written at a SMALLER dp extent (saved at
+    ``n_old = n_new // 2`` and remapped UP) must trace the byte-identical
+    step a fresh-start trainer at the larger world size traces. This is
+    the proof the scale-up path rides on (docs/resilience.md "Scale-up &
+    fleet scheduling"): the supervisor's probe-triggered grow and the
+    fleet scheduler's chip receipts both relaunch ``--resume`` onto MORE
+    devices, so the remapper's zero-repad of the ZeRO-1 flat vectors,
+    the r1 fold into more replica rows, and the r2 re-pad must reproduce
+    exactly the aval layout a fresh construction gets. Probe machinery
+    shared with TD111: :func:`_elastic_noop_probe` (extents swapped)."""
+    layouts_differ, fired, identical = _elastic_noop_probe(mesh, grow=True)
+    out: list[Violation] = []
+    if layouts_differ and not fired:
+        out.append(
+            Violation(
+                "TD112",
+                "<jaxpr:dp_elastic_grow_noop>",
+                0,
+                "the TD112 probe restored a smaller-world checkpoint "
+                "onto more devices but the elastic remapper never fired "
+                "— the armed-vs-fresh comparison would be vacuous; the "
+                "restore path stopped routing grow shape mismatches "
+                "through the remap hook",
+                snippet="elastic grow remapper did not fire",
+            )
+        )
+    if not identical:
+        out.append(
+            Violation(
+                "TD112",
+                "<jaxpr:dp_elastic_grow_noop>",
+                0,
+                "the traced train step of a GROW-resumed trainer (state "
+                "saved at a smaller dp extent, remapped up) differs from "
+                "a fresh-start trainer at the same larger world size — "
+                "the scale-up remap leaked into the compiled program "
+                "(shape/dtype drift in the re-laid ZeRO-1/EF flat "
+                "layouts; tpu_dist/elastic/remap.py contract)",
+                snippet="jaxpr(fresh_start) != jaxpr(grow_resumed)",
+            )
+        )
+    return out
+
+
 def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     """Run every (or the named) registered case. Returns
     ``(report, violations)`` where report maps case → op counts.
@@ -1090,7 +1150,8 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     reference pairs the report contains; full (unfiltered) runs also check
     the TD105 fault-injection, TD106 telemetry, TD107 device-metrics,
     TD108 profiler-trigger, TD109 live-export/alerting, TD110
-    capture-auto-analyze, and TD111 elastic-resume no-op invariants."""
+    capture-auto-analyze, TD111 elastic-resume, and TD112 elastic-grow
+    no-op invariants."""
     report: dict = {}
     violations: list[Violation] = []
     for name in names if names is not None else registered_cases():
@@ -1119,6 +1180,9 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
         violations.extend(vs)
         vs = elastic_resume_noop_violations(mesh)
         report["dp_elastic_resume_noop"] = {"identical": not vs}
+        violations.extend(vs)
+        vs = elastic_grow_noop_violations(mesh)
+        report["dp_elastic_grow_noop"] = {"identical": not vs}
         violations.extend(vs)
     return report, violations
 

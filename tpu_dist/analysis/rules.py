@@ -167,6 +167,18 @@ RULES: dict[str, Rule] = {
             "construction gets (tpu_dist/elastic/remap.py contract)",
         ),
         Rule(
+            "TD112",
+            "elastic-grow-not-noop",
+            "the traced train step of a GROW-resumed trainer (state "
+            "restored from a checkpoint written at a SMALLER dp extent "
+            "and remapped up onto more devices) differs from a "
+            "fresh-start trainer at the same larger world size — the "
+            "scale-up remap must be restore-time host work that "
+            "reproduces exactly the shapes/dtypes a fresh construction "
+            "gets (the grow mirror of TD111; tpu_dist/elastic/remap.py "
+            "contract)",
+        ),
+        Rule(
             "TD104",
             "quantized-wire-bytes-over-budget",
             "gradient-collective payload bytes of a quantized wire format "
@@ -255,8 +267,13 @@ RANK_CALL_SUFFIXES = ("process_index", "is_primary", "get_rank")
 RANK_VAR_NAMES = {"rank", "local_rank", "process_id", "proc_id", "process_index", "pid"}
 
 # Modules exempt from TD002: host-side tooling that never runs inside a
-# multi-process training job (the analysis and obs CLIs' report output).
-TD002_EXEMPT_PARTS = ("tpu_dist/analysis/", "tpu_dist/obs/__main__.py")
+# multi-process training job (the analysis and obs CLIs' report output,
+# and the fleet controller — the scheduler/drill/capacity census run in
+# the single arbiter/launcher process, whose FILES are the control
+# channel the runs' probes read).
+TD002_EXEMPT_PARTS = (
+    "tpu_dist/analysis/", "tpu_dist/obs/__main__.py", "tpu_dist/fleet/",
+)
 
 # TD007 allowlist: the designated output layer (rank0_print/get_logger and
 # the ProgressMeter display sink, which carries the rank-0 guard itself)

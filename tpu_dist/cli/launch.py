@@ -68,6 +68,24 @@ sampler re-partitions the remaining examples. Bounded by
 ``--elastic_max_restarts``. A SIGTERM to the LAUNCHER itself still means
 "the orchestrator wants the job gone": elastic stands down and the
 distinct requeue-75 code propagates as before.
+
+Scale-up contract (docs/resilience.md "Scale-up & fleet scheduling"):
+with ``--elastic_probe_interval`` set, a shrunken run does not stay
+small forever. The running round polls a capacity census — the
+``--elastic_capacity_file`` allocation file when given (the channel the
+fleet scheduler writes), else ``TPU_DIST_AVAILABLE_PROCS``, else the
+original ``--nproc`` (a dedicated host's chips "return" as soon as the
+preemption ends) — at the probe interval, with a deterministic
+``resilience/retry.py`` cooldown between grow decisions so a flapping
+census cannot thrash the run. When the census staffs a larger feasible
+divisor (bounded by ``--elastic_max_procs``), the round gracefully
+SIGTERMs its own world — every rank checkpoints and exits 75 — and the
+supervisor relaunches ``--resume`` at the new size; the elastic restore
+ladder grows the state back bit-exactly (TD112). The same probe carries
+scheduler-initiated donations (the allocation file dropped below the
+current size) and caps failure relaunches (never respawn onto chips the
+scheduler took away). Resizes consume no restart budget. A SIGTERM to
+the launcher stands the WHOLE policy down, probe included.
 """
 
 from __future__ import annotations
@@ -119,6 +137,35 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="base of the deterministic exponential backoff between "
              "elastic relaunches (resilience/retry.py schedule: "
              "S * 2^restart, capped at 30s)",
+    )
+    p.add_argument(
+        "--elastic_probe_interval", type=float, default=0.0, metavar="S",
+        help="with the elastic supervisor on: poll the capacity census "
+             "every S seconds while a round runs; when it staffs a "
+             "larger feasible divisor the round checkpoints (graceful "
+             "SIGTERM -> exit 75) and relaunches --resume at the bigger "
+             "size — a shrunken run grows back when chips return. A "
+             "census below the current size is a scheduler donation: "
+             "same path, smaller relaunch. 0 (default) disables probing",
+    )
+    p.add_argument(
+        "--elastic_max_procs", type=int, default=0, metavar="N",
+        help="ceiling for probe-driven grows (never above --nproc); "
+             "0 (default) = --nproc",
+    )
+    p.add_argument(
+        "--elastic_capacity_file", default=None, metavar="PATH",
+        help="allocation file the capacity census reads (one integer, "
+             "atomically written — the fleet scheduler's channel, "
+             "tpu_dist/fleet/capacity.py); without it the census falls "
+             "back to TPU_DIST_AVAILABLE_PROCS, then to --nproc",
+    )
+    p.add_argument(
+        "--elastic_same_size_retries", type=int, default=2, metavar="K",
+        help="consecutive whole-pod-loss retries at the SAME world size "
+             "before the supervisor steps down one divisor (floor "
+             "permitting) — one flaky round doesn't shrink the run, a "
+             "persistently preempted size doesn't burn the whole budget",
     )
     p.add_argument(
         "--heartbeat_dir", default=None,
@@ -195,19 +242,60 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     except ValueError:  # not the main thread (embedded use) — skip
         prev_term = None
     try:
-        def round_fn(nproc: int, restart: int) -> RoundResult:
-            return _run_round(
-                args, cmd, nproc, restart, hb_base, metrics_base,
-                live, launcher_sig,
-            )
-
-        if args.elastic_min_procs <= 0:
-            return round_fn(args.nproc, 0).rc
-
         def say(msg: str) -> None:
             # tpu-dist: ignore[TD002,TD007] — the launcher IS the single
             # parent process and stderr is its orchestrator contract
             print(f"launch: {msg}", file=sys.stderr, flush=True)
+
+        probe = None
+        start_procs = None
+        if args.elastic_min_procs > 0 and args.elastic_probe_interval > 0:
+            from tpu_dist.elastic.supervisor import (  # noqa: PLC0415
+                CapacityProbe,
+                next_world_size,
+            )
+            from tpu_dist.fleet import capacity as capacity_lib  # noqa: PLC0415
+
+            probe = CapacityProbe(
+                capacity_lib.make_census(
+                    args.elastic_capacity_file, default=args.nproc
+                ),
+                original=args.nproc,
+                min_procs=args.elastic_min_procs,
+                max_procs=args.elastic_max_procs,
+                interval=args.elastic_probe_interval,
+            )
+            # the census is authoritative from BIRTH: a run whose chips
+            # are currently granted elsewhere (the fleet scheduler wrote
+            # a smaller allocation before launch) must not spawn round 0
+            # on top of another run and then shrink — start at the
+            # granted feasible size; the probe grows it back later
+            avail = probe.available()
+            if avail is not None and avail < args.nproc:
+                granted = next_world_size(
+                    args.nproc, int(avail), args.elastic_min_procs
+                )
+                if granted is None:
+                    say(
+                        f"elastic: capacity census grants only {avail} "
+                        f"proc(s) — below min_procs="
+                        f"{args.elastic_min_procs}; refusing to start"
+                    )
+                    return 1
+                say(
+                    f"elastic: capacity census grants {granted} of "
+                    f"{args.nproc} proc(s) at launch"
+                )
+                start_procs = granted
+
+        def round_fn(nproc: int, restart: int) -> RoundResult:
+            return _run_round(
+                args, cmd, nproc, restart, hb_base, metrics_base,
+                live, launcher_sig, probe=probe, say=say,
+            )
+
+        if args.elastic_min_procs <= 0:
+            return round_fn(args.nproc, 0).rc
 
         return supervise(
             round_fn,
@@ -217,6 +305,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             backoff_base=args.elastic_backoff,
             announce=say,
             should_continue=lambda: not launcher_sig[0],
+            probe=probe,
+            same_size_retries=args.elastic_same_size_retries,
+            start_procs=start_procs,
         )
     finally:
         if prev_term is not None:
@@ -234,18 +325,32 @@ def _run_round(
     metrics_base: Optional[str],
     live: List[subprocess.Popen],
     launcher_sig: List[bool],
+    probe=None,
+    say=None,
 ) -> RoundResult:
     """Spawn and supervise ONE world: ``nproc`` children at a fresh
     coordinator port, fail-fast + watchdog + preemption semantics exactly
     as the single-round launcher always had. Returns the aggregate exit
     code plus every rank's raw exit status — the elastic supervisor's
     survivor census. ``live`` is the launcher-level registry the SIGTERM
-    handler forwards to (children of the current round only)."""
+    handler forwards to (children of the current round only).
+
+    ``probe`` (a ``CapacityProbe``) arms the resize path: the wait loop
+    polls it, and a census that staffs a different feasible size makes
+    this round stand its own world down gracefully (SIGTERM -> every
+    rank checkpoints and exits 75) and report ``resize_to`` — the
+    supervisor relaunches ``--resume`` at the new size."""
     port = args.port or _free_port()
     procs: List[subprocess.Popen] = []
     ranks: Dict[subprocess.Popen, int] = {}
     exits: Dict[int, int] = {}
     preempted = [launcher_sig[0]]  # a child's exit-75 also sets this
+    resize_to: List[Optional[int]] = [None]  # probe-requested new size
+    announce = say if say is not None else (lambda _msg: None)
+    if probe is not None:
+        # a freshly spawned world always gets one full probe interval to
+        # settle before the census may bounce it again
+        probe.reset_timer()
 
     try:
         for rank in range(nproc):
@@ -325,12 +430,7 @@ def _run_round(
                 v = gauge(raw)
                 if v is not None:
                     parts.append(f"{label} {format(v, spec)}")
-            active_prefix = export_lib.metric_name("alert_active") + "{"
-            active = sorted(
-                name[len(active_prefix):].split('"')[1]
-                for name, v in vals.items()
-                if name.startswith(active_prefix) and v
-            )
+            active = export_lib.active_labels(vals)
             if active:
                 parts.append(f"active alerts: {', '.join(active)}")
             return (
@@ -341,13 +441,13 @@ def _run_round(
             nonlocal crash_rc
             from tpu_dist.obs import heartbeat as heartbeat_lib  # noqa: PLC0415
 
-            if preempted[0] or launcher_sig[0]:
-                # preemption shutdown: each child beats once ('preempted')
-                # then goes silent in its emergency save BY DESIGN — a
-                # frozen counter here is not a wedge, and reclassifying it
-                # would turn the requeue-75 exit into a crash. A truly
-                # stuck shutdown is bounded by the platform's own SIGKILL
-                # deadline, not by us.
+            if preempted[0] or launcher_sig[0] or resize_to[0] is not None:
+                # preemption/resize shutdown: each child beats once
+                # ('preempted') then goes silent in its emergency save BY
+                # DESIGN — a frozen counter here is not a wedge, and
+                # reclassifying it would turn the requeue-75 exit into a
+                # crash. A truly stuck shutdown is bounded by the
+                # platform's own SIGKILL deadline, not by us.
                 return
             rank = ranks[pr]
             t = time.monotonic()
@@ -389,6 +489,27 @@ def _run_round(
 
         pending = list(procs)
         while pending:
+            if (
+                probe is not None and resize_to[0] is None
+                and not preempted[0] and not launcher_sig[0]
+                and crash_rc == 0
+            ):
+                target = probe.poll(nproc)
+                if target is not None and target != nproc:
+                    # capacity changed: stand this world down gracefully —
+                    # every rank checkpoints (emergency save) and exits 75,
+                    # and the supervisor relaunches --resume at the target
+                    resize_to[0] = target
+                    announce(
+                        "elastic: capacity census wants world size "
+                        f"{target} (running {nproc}) — checkpointing this "
+                        "round for the resize"
+                    )
+                    for pr in list(pending):
+                        try:
+                            pr.send_signal(signal.SIGTERM)
+                        except OSError:  # tpu-dist: ignore[TD006] — child gone
+                            pass
             for pr in list(pending):
                 ret = pr.poll()
                 if ret is None:
@@ -413,15 +534,20 @@ def _run_round(
                 except subprocess.TimeoutExpired:
                     pass
         if crash_rc:
-            # a crash/wedge outranks a concurrent preemption
+            # a crash/wedge outranks a concurrent preemption AND a resize
+            # request (the supervisor's failure path must see the real
+            # census, not a voluntary-looking resize)
             return RoundResult(crash_rc, exits)
-        if (preempted[0] or launcher_sig[0]) and rc in (
-            0, PREEMPTION_EXIT_CODE, -signal.SIGTERM
-        ):
+        if (
+            preempted[0] or launcher_sig[0]
+            or (resize_to[0] is not None and rc != 0)
+        ) and rc in (0, PREEMPTION_EXIT_CODE, -signal.SIGTERM):
             # the whole job was preempted (not crashed): surface the
             # distinct requeue-me code even if some child died on the raw
-            # signal before its handler was installed
-            return RoundResult(PREEMPTION_EXIT_CODE, exits)
+            # signal before its handler was installed. A probe-driven
+            # resize rides this same path (graceful 75s) and carries its
+            # target so the supervisor relaunches instead of retrying.
+            return RoundResult(PREEMPTION_EXIT_CODE, exits, resize_to[0])
         return RoundResult(rc, exits)
     finally:
         for pr in procs:

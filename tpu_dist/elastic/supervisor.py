@@ -23,6 +23,22 @@ The loop:
    by ``max_restarts`` — a deterministic crash loop burns its budget and
    surfaces the real exit code instead of cycling forever.
 
+Scale-UP closes the other half of the loop (docs/resilience.md
+"Scale-up & fleet scheduling"): a shrunken run stays small forever
+unless somebody notices the preempted chips came back. The supervisor
+owns that too — a :class:`CapacityProbe` (injectable census + clock, a
+fixed probe interval, and a deterministic ``resilience/retry.py``
+cooldown between grow decisions) is polled by the RUNNING round; when
+the census staffs a larger feasible divisor the round checkpoints its
+world (graceful SIGTERM → exit 75) and reports ``resize_to``, and the
+loop relaunches ``--resume`` at the new size. Resizes are voluntary:
+they consume no restart budget and wait no failure backoff. The same
+probe drives scheduler-initiated *donations* (the census shrank below
+the current size — ``tpu_dist/fleet/scheduler.py`` moved this run's
+chips to a sibling), and on a FAILURE relaunch the census caps the
+survivor-derived target, so a round never respawns onto chips an
+external scheduler already took away.
+
 The mid-run *state* story (checkpoint remap onto the new dp extent,
 sampler re-partitioning) lives in ``tpu_dist/elastic/remap.py`` and the
 trainer's restore ladder; the relaunched children just run ``--resume``.
@@ -47,10 +63,18 @@ SURVIVOR_EXITS = frozenset({0, PREEMPTION_EXIT_CODE, -int(signal.SIGTERM)})
 @dataclasses.dataclass
 class RoundResult:
     """One launcher round's outcome: the aggregate exit code the launcher
-    would have returned, and each rank's raw exit status."""
+    would have returned, and each rank's raw exit status.
+
+    ``resize_to`` is set when the round ended because the CAPACITY PROBE
+    asked it to (a grow when chips returned, a shrink when the fleet
+    scheduler donated this run's chips away): the round SIGTERMed its own
+    world — every rank checkpointed and exited 75 — and the supervisor
+    should relaunch ``--resume`` at that size without touching the
+    failure budget."""
 
     rc: int
     rank_exits: Dict[int, int]
+    resize_to: Optional[int] = None
 
     def survivors(self) -> int:
         return sum(
@@ -80,6 +104,149 @@ def next_world_size(
     return None
 
 
+def grow_target(
+    original: int, current: int, available: int, max_procs: int = 0
+) -> Optional[int]:
+    """Largest feasible world size the AVAILABLE capacity staffs that is
+    strictly larger than ``current`` and within ``max_procs`` (0 = the
+    original launch size — elastic never grows a run past what it was
+    asked for); None when capacity doesn't reach the next divisor up."""
+    bound = min(max_procs, original) if max_procs > 0 else original
+    for n in feasible_sizes(original):
+        if current < n <= min(available, bound):
+            return n
+    return None
+
+
+def shrink_target(
+    original: int, current: int, available: int, min_procs: int
+) -> Optional[int]:
+    """Largest feasible world size at or below ``available`` and strictly
+    below ``current``, honoring the floor — the donation half of a
+    capacity change (the census says this run's chips were taken). None
+    when no feasible smaller size exists (the run keeps its chips rather
+    than dying: a donation must never do what a preemption couldn't)."""
+    for n in feasible_sizes(original):
+        if n < current and n <= available and n >= max(1, min_procs):
+            return n
+    return None
+
+
+class CapacityProbe:
+    """Deterministic capacity-probe state machine (docs/resilience.md
+    "Scale-up & fleet scheduling").
+
+    ``census`` is the injectable capacity source — how many processes'
+    worth of chips this run may use *right now* (the launcher backs it
+    with ``tpu_dist/fleet/capacity.py``: an allocation file the fleet
+    scheduler owns, an env override, or "the original size" when nothing
+    external constrains the run). :meth:`poll` is called from the running
+    round's wait loop and returns a resize target — a GROW when the
+    census staffs a larger feasible divisor, a SHRINK when it dropped
+    below the current size — or None.
+
+    Determinism: probes fire on a fixed ``interval`` of the injectable
+    ``clock`` (tests pass ``now`` explicitly), and each grow decision
+    arms the ``resilience/retry.py`` exponential cooldown
+    (``cooldown_base * 2**k`` capped at ``cooldown_max``) before the next
+    one, so a flapping census cannot thrash the run through
+    checkpoint/relaunch cycles. Shrinks are NOT cooled down — the chips
+    are already gone; delaying the handover only burns the donor's and
+    recipient's time (the fleet scheduler has its own per-run move
+    cooldown at decision grain).
+    """
+
+    def __init__(
+        self,
+        census: Callable[[], Optional[int]],
+        *,
+        original: int,
+        min_procs: int = 1,
+        max_procs: int = 0,
+        interval: float = 30.0,
+        cooldown_base: Optional[float] = None,
+        cooldown_max: float = 600.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if original <= 0:
+            raise ValueError(f"original world size must be positive, got {original}")
+        if interval <= 0:
+            raise ValueError(f"probe interval must be positive, got {interval}")
+        self.census = census
+        self.original = int(original)
+        self.min_procs = int(min_procs)
+        self.max_procs = int(max_procs)
+        self.interval = float(interval)
+        self.cooldown_base = (
+            float(cooldown_base) if cooldown_base is not None
+            else 2.0 * self.interval
+        )
+        self.cooldown_max = float(cooldown_max)
+        self.clock = clock
+        self.grows = 0  # grow decisions issued (drives the cooldown index)
+        self._next_probe: Optional[float] = None  # first poll arms it
+        self._grow_cooldown_until = float("-inf")  # grows only — a shrink
+        #                            (the chips are gone) is never delayed
+
+    def available(self) -> Optional[int]:
+        """One raw census read (no pacing) — the supervisor consults this
+        on a FAILURE relaunch to cap the survivor-derived target; None
+        means the census cannot answer (treat as unconstrained)."""
+        try:
+            avail = self.census()
+        except OSError:
+            return None  # an unreadable census must never kill the policy
+        return int(avail) if avail is not None else None
+
+    def poll(self, current: int, now: Optional[float] = None) -> Optional[int]:
+        """Consult the census at probe grain; returns the resize target
+        (grow or shrink) or None. The first call only arms the timer —
+        a freshly (re)launched round gets a full interval to settle
+        before any census reading can bounce it again."""
+        now = self.clock() if now is None else now
+        if self._next_probe is None:
+            self._next_probe = now + self.interval
+            return None
+        if now < self._next_probe:
+            return None
+        self._next_probe = now + self.interval
+        avail = self.available()
+        if avail is None:
+            return None
+        if avail < current:
+            # a shrink ends the current shrink→grow cycle: the NEXT cycle
+            # starts its grow-cooldown ladder from the base again (a
+            # long-lived fleet run legitimately donates and receives many
+            # times — an ever-growing streak would eventually park freed
+            # chips for the max cooldown). The cooldown ARMED by the last
+            # grow still stands, so a census flapping up-down-up is still
+            # paced to at most one full cycle per grow cooldown.
+            self.grows = 0
+            return shrink_target(
+                self.original, current, avail, self.min_procs
+            )
+        if now < self._grow_cooldown_until:
+            return None
+        target = grow_target(self.original, current, avail, self.max_procs)
+        if target is None:
+            return None
+        # arm the deterministic grow cooldown: the k-th grow waits the
+        # k-th retry.py backoff delay before the NEXT grow may fire
+        self.grows += 1
+        self._grow_cooldown_until = now + backoff_delays(
+            self.grows, self.cooldown_base, self.cooldown_max
+        )[self.grows - 1]
+        return target
+
+    def reset_timer(self, now: Optional[float] = None) -> None:
+        """Re-arm the probe interval from ``now`` — the launcher calls
+        this when a new round spawns so the fresh world always gets one
+        full interval of peace (the grow cooldown is separate state and
+        survives untouched)."""
+        now = self.clock() if now is None else now
+        self._next_probe = max(self._next_probe or 0.0, now + self.interval)
+
+
 def supervise(
     run_round: Callable[[int, int], RoundResult],
     *,
@@ -91,22 +258,52 @@ def supervise(
     sleep: Optional[Callable[[float], None]] = None,
     announce: Optional[Callable[[str], None]] = None,
     should_continue: Optional[Callable[[], bool]] = None,
+    probe: Optional[CapacityProbe] = None,
+    same_size_retries: int = 2,
+    start_procs: Optional[int] = None,
 ) -> int:
-    """Drive ``run_round(world_size, restart_index)`` until the run
+    """Drive ``run_round(world_size, round_index)`` until the run
     completes, the restart budget is spent, or the pod shrinks below the
     floor. Returns the exit code of the final round (0 on success).
 
     ``should_continue`` is consulted before every relaunch: the launcher
     passes "I was not myself SIGTERMed" — when the ORCHESTRATOR preempts
     the whole job (signal to the launcher), elastic must surface the
-    requeue code upward, not fight the scheduler by relaunching locally."""
+    requeue code upward, not fight the scheduler by relaunching locally.
+    That stand-down outranks every other branch here, resizes included.
+
+    ``probe`` arms the scale-up/donation half: a round that ends with
+    ``resize_to`` set (the running round polled the probe and SIGTERMed
+    itself) is relaunched at that size immediately — no failure backoff,
+    no restart-budget charge (resizes are voluntary and self-bounding:
+    grows strictly increase through the divisor chain and are paced by
+    the probe's own cooldown). On a FAILURE relaunch the probe's census
+    additionally caps the survivor-derived target — exit codes say who
+    died, the census says whose chips exist at all.
+
+    ``start_procs`` launches the FIRST round at a smaller feasible size
+    than ``nproc`` (the launcher passes the census-granted allocation —
+    a run whose chips are currently lent out must not spawn round 0 on
+    top of another run); every feasibility computation still derives
+    from the original ``nproc``, so the run grows back to full size
+    when the probe says the chips returned.
+
+    ``same_size_retries`` bounds the whole-pod-loss retry: a round where
+    every rank was reschedulable retries at the SAME size at most that
+    many consecutive times, then steps down one feasible divisor (floor
+    permitting) instead of burning the entire restart budget waiting for
+    capacity that isn't coming back — while the first flaky round still
+    never shrinks the run permanently (scale-up grows it back anyway)."""
     do_sleep = sleep if sleep is not None else time.sleep
     say = announce if announce is not None else (lambda _msg: None)
     keep_going = should_continue if should_continue is not None else (lambda: True)
     delays = backoff_delays(max(1, max_restarts), backoff_base, backoff_max)
-    n = nproc
-    res = run_round(n, 0)
-    for restart in range(max_restarts):
+    n = start_procs if start_procs is not None else nproc
+    round_idx = 0
+    restarts_used = 0
+    same_size_used = 0
+    res = run_round(n, round_idx)
+    while True:
         if res.rc == 0:
             return 0
         if not keep_going():
@@ -115,13 +312,57 @@ def supervise(
                 f"surfacing exit {res.rc} instead of relaunching"
             )
             return res.rc
+        if res.resize_to is not None and res.resize_to != n:
+            # voluntary resize (probe-driven): the round already
+            # checkpointed and stood its world down — relaunch --resume
+            # at the new size now; no failure backoff, no budget charge
+            target = res.resize_to
+            say(
+                "elastic: "
+                + ("capacity returned — growing" if target > n
+                   else "chips donated — shrinking")
+                + f" from world size {n} to {target} (round "
+                f"{round_idx + 1}, restart budget untouched at "
+                f"{restarts_used}/{max_restarts})"
+            )
+            same_size_used = 0
+            n = target
+            round_idx += 1
+            res = run_round(n, round_idx)
+            continue
+        if restarts_used >= max_restarts:
+            say(
+                f"elastic: restart budget ({max_restarts}) spent; "
+                f"surfacing exit {res.rc}"
+            )
+            return res.rc
         lost = res.lost()
-        survivors = res.survivors()  # the census is the single source
+        survivors = res.survivors()  # the exit-code census
         if lost == 0:
-            # whole-pod preemption: every rank is reschedulable — retry at
-            # the same size (the orchestrator-requeue case, done locally)
-            target = n
+            # whole-pod preemption: every rank is reschedulable — retry
+            # at the same size, but only ``same_size_retries`` times in a
+            # row before stepping down a divisor (a pod that keeps
+            # preempting whole is not coming back this backoff window)
+            if same_size_used < same_size_retries:
+                same_size_used += 1
+                target = n
+            else:
+                smaller = [
+                    s for s in feasible_sizes(nproc)
+                    if s < n and s >= max(1, min_procs)
+                ]
+                if smaller:
+                    target = smaller[0]
+                    say(
+                        f"elastic: {same_size_used} same-size retries at "
+                        f"world size {n} all lost the whole pod — "
+                        f"stepping down to {target}"
+                    )
+                    same_size_used = 0
+                else:
+                    target = n  # already at the floor: keep trying
         else:
+            same_size_used = 0
             target = next_world_size(nproc, survivors, min_procs)
             if target is None:
                 say(
@@ -130,11 +371,38 @@ def supervise(
                     f"giving up with exit {res.rc}"
                 )
                 return res.rc
-        delay = delays[min(restart, len(delays) - 1)]
+        if probe is not None:
+            # the external census caps a failure relaunch: survivors'
+            # exit codes prove who CAN reschedule, the capacity census
+            # says how many chips still belong to this run at all
+            avail = probe.available()
+            if avail is not None and avail < target:
+                capped = next_world_size(nproc, int(avail), min_procs)
+                if capped is None:
+                    say(
+                        f"elastic: capacity census reports {avail} "
+                        f"proc(s) available — no feasible world size >= "
+                        f"min_procs={min_procs}; giving up with exit "
+                        f"{res.rc}"
+                    )
+                    return res.rc
+                if capped != target:
+                    say(
+                        f"elastic: capacity census caps the relaunch at "
+                        f"{capped} (survivors allowed {target}, census "
+                        f"reports {avail} available)"
+                    )
+                    target = capped
+        if target != n:
+            # any size change starts a fresh same-size streak — a
+            # census-capped relaunch must not inherit the old size's
+            # spent retries (the step-down/loss branches reset above)
+            same_size_used = 0
+        delay = delays[min(restarts_used, len(delays) - 1)]
         say(
             f"elastic: relaunching at world size {target} (was {n}, "
-            f"{lost} rank(s) lost; restart {restart + 1}/{max_restarts}, "
-            f"backoff {delay:g}s)"
+            f"{lost} rank(s) lost; restart {restarts_used + 1}/"
+            f"{max_restarts}, backoff {delay:g}s)"
         )
         do_sleep(delay)
         if not keep_going():
@@ -146,11 +414,7 @@ def supervise(
                 f"{res.rc} instead of relaunching"
             )
             return res.rc
+        restarts_used += 1
+        round_idx += 1
         n = target
-        res = run_round(n, restart + 1)
-    if res.rc != 0:
-        say(
-            f"elastic: restart budget ({max_restarts}) spent; surfacing "
-            f"exit {res.rc}"
-        )
-    return res.rc
+        res = run_round(n, round_idx)

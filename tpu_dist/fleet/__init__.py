@@ -1,0 +1,23 @@
+"""Pod-level fleet control (docs/resilience.md "Scale-up & fleet
+scheduling").
+
+PR 6-9 built the sensors (goodput ledger, OpenMetrics export, pod
+aggregation) and the actuator (the elastic supervisor's
+checkpoint-remap-relaunch path); this package is the control loop that
+connects them:
+
+* :mod:`tpu_dist.fleet.capacity` — the capacity census: per-run
+  allocation files the scheduler owns and the launcher's
+  :class:`~tpu_dist.elastic.supervisor.CapacityProbe` reads. The file is
+  the single communication channel between the arbiter and a run's
+  supervisor — no sockets, no shared state, auditable with ``cat``.
+* :mod:`tpu_dist.fleet.scheduler` — the goodput-aware arbiter:
+  gang-schedules N runs on one pod and reallocates chips at epoch-grain
+  decision points from the signals the obs stack already exports per run
+  (data-stall fraction, goodput, MFU, active alerts, heartbeat
+  liveness). Every decision is an auditable ``fleet`` history record
+  carrying the inputs that justified it.
+* :mod:`tpu_dist.fleet.drill` — ``make fleet-drill``: the end-to-end
+  proof (preempt-shrink, probe-grow with loss parity, then a
+  metrics-driven chip move between two live supervised runs).
+"""

@@ -1,0 +1,92 @@
+"""The capacity census — what the elastic supervisor's probe reads
+(docs/resilience.md "Scale-up & fleet scheduling").
+
+A run's *allocation* is the number of processes' worth of chips it may
+use right now. The channel is deliberately dumb: one small text file per
+run holding one integer, written atomically (tmp + ``os.replace``, the
+heartbeat discipline) by whoever owns capacity — the fleet scheduler
+(``tpu_dist/fleet/scheduler.py``), an external orchestrator, or a human
+with ``echo``. The launcher's :class:`~tpu_dist.elastic.supervisor.
+CapacityProbe` polls it; a change in either direction rides the proven
+elastic path (graceful SIGTERM → checkpoint → relaunch ``--resume`` at
+the new size).
+
+Census resolution order (:func:`make_census`):
+
+1. the allocation file (``--elastic_capacity_file``) when given,
+2. the ``TPU_DIST_AVAILABLE_PROCS`` environment variable (set by an
+   orchestrator that can't write files into the run's tree),
+3. the static default — the original launch size: on a dedicated host
+   the preempted chips "return" as soon as the preemption ends, so an
+   unconstrained run always wants to grow back to what it was asked for.
+
+Stdlib-only and jax-free: the launcher imports this before any backend
+exists, and the scheduler runs on machines that only see the files.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Callable, Optional
+
+#: Environment override an orchestrator can set for a whole launcher
+#: process tree (resolution order 2 — see module docstring).
+CAPACITY_ENV = "TPU_DIST_AVAILABLE_PROCS"
+
+
+def read_allocation(path: str) -> Optional[int]:
+    """The census read: the integer in ``path``, or None when the file is
+    absent, empty, or torn (an atomic writer makes torn rare; a missing
+    file means nobody constrains this run yet). Never raises — the probe
+    must degrade to "no answer", not kill the supervisor."""
+    try:
+        with open(path) as f:
+            text = f.read().strip()
+    except OSError:
+        return None
+    if not text:
+        return None
+    try:
+        return int(text.split()[0])
+    except ValueError:
+        return None
+
+
+def write_allocation(path: str, n: int) -> None:
+    """Atomically publish allocation ``n`` (tmp + ``os.replace`` — a
+    concurrent :func:`read_allocation` sees the old value or the new one,
+    never a torn write)."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(f"{int(n)}\n")
+    os.replace(tmp, path)
+
+
+def make_census(
+    capacity_file: Optional[str] = None,
+    *,
+    default: Optional[int] = None,
+    env: Optional[dict] = None,
+) -> Callable[[], Optional[int]]:
+    """Build the probe's census callable (module docstring for the
+    resolution order). ``env`` is injectable for tests; ``default`` is
+    the launcher's original ``--nproc``."""
+    environ = env if env is not None else os.environ
+
+    def census() -> Optional[int]:
+        if capacity_file:
+            n = read_allocation(capacity_file)
+            if n is not None:
+                return n
+        raw = (environ.get(CAPACITY_ENV) or "").strip()
+        # strict ASCII-integer match: `"--4".lstrip("+-").isdigit()`-style
+        # checks pass values int() then rejects, and a garbage env var
+        # must degrade down the chain, never crash the launcher's probe
+        if re.fullmatch(r"[+-]?[0-9]+", raw):
+            return int(raw)
+        return default
+
+    return census

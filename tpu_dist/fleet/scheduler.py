@@ -1,0 +1,517 @@
+"""Goodput-aware chip arbitration across runs sharing one pod
+(docs/resilience.md "Scale-up & fleet scheduling").
+
+Multiple workloads time-sharing the same chips is the expected
+production shape (PAPERS.md: Gemma fine-tune + serve on one pod), and at
+pod scale worker churn is routine (PAPERS.md: Concurrency on Google
+TPUs) — so chips should sit where they buy goodput, not where the
+original submission happened to put them. This module is the arbiter:
+
+* **Sensors** — the per-run signals the obs stack already exports:
+  each run's OpenMetrics textfile (``--metrics_file``; scraped with
+  ``obs/export.py::scrape``) carries data-stall fraction, goodput
+  fraction, MFU and the active-alert gauges, and its heartbeat file
+  answers liveness. Nothing here instruments a run — the scheduler is a
+  pure reader of artifacts that exist anyway.
+* **Policy** (:meth:`FleetScheduler.decide`) — at epoch-grain decision
+  points (integer ``tick``), a run data-stalled past
+  ``donate_stall_frac`` donates chips toward a compute-bound one under
+  ``receive_stall_frac``. Donated chips are **pending until the next
+  tick**: the donor needs its checkpoint→relaunch window to actually
+  vacate them, so granting in the same instant would transiently
+  oversubscribe the pool — the recipient is granted from the FREE pool
+  only, one tick later. Hysteresis (a run that just received must
+  breach the donate threshold by an extra margin before donating back,
+  and vice versa) plus a per-run move cooldown keep allocations from
+  thrashing; a run with active alerts or a stale heartbeat is vetoed
+  from receiving; a donor never drops below its ``min_procs`` floor.
+  The function is pure: (state, tick, signals) → decisions, no clock —
+  every decision is reproducible from its recorded inputs.
+* **Actuator** — a decision writes the runs' allocation files
+  (``fleet/capacity.py``); each run's elastic supervisor probe picks the
+  change up and rides the proven path (donor: SIGTERM → checkpoint →
+  exit 75 → relaunch smaller; recipient: probe → grow-resume). The
+  scheduler never signals a training process directly.
+* **Audit** — every decision appends a ``fleet`` history record
+  (schema-additive; ``obs summarize``/``pod`` render it) carrying the
+  allocations before/after AND the full signal inputs that justified
+  the move, plus ``fleet.allocation.<run>`` gauges / ``fleet.decisions``
+  counter and an optional OpenMetrics exposition
+  (``tpu_dist_fleet_allocation{run="..."}``).
+
+Stdlib-only (no jax): the arbiter runs wherever the metrics files are
+visible — the pod's controller VM, a laptop over a mount.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tpu_dist.elastic.supervisor import (
+    feasible_sizes,
+    grow_target,
+    shrink_target,
+)
+from tpu_dist.fleet import capacity as capacity_lib
+from tpu_dist.obs import counters as counters_lib
+from tpu_dist.obs import export as export_lib
+
+#: ``fleet`` records stamp the history schema they were introduced in
+#: (metrics/history.py v8 — additive). Kept as a literal so this module
+#: stays jax-free; ``tests/test_fleet.py`` pins it to the real
+#: SCHEMA_VERSION so the two can never drift silently.
+FLEET_SCHEMA_VERSION = 8
+
+#: Heartbeat older than this reads as a dead/wedged run (matches the
+#: ``obs tail`` STALE threshold and the builtin heartbeat_stale rule).
+STALE_AFTER_S = 60.0
+
+
+@dataclasses.dataclass(frozen=True)
+class RunSpec:
+    """One gang-scheduled run: its name, the size it was submitted at
+    (``original`` — also its ceiling: the arbiter never grows a run past
+    what it asked for), and its floor."""
+
+    name: str
+    original: int
+    min_procs: int = 1
+
+    def __post_init__(self):
+        if self.original <= 0:
+            raise ValueError(f"{self.name}: original size must be positive")
+        if not 1 <= self.min_procs <= self.original:
+            raise ValueError(
+                f"{self.name}: min_procs {self.min_procs} outside "
+                f"[1, {self.original}]"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class RunSignals:
+    """One run's scraped sensor readings at a decision point. ``None``
+    means the signal is absent (run not exporting yet) — absent signals
+    make a run ineligible for moves in either direction rather than
+    defaulting to a number."""
+
+    run: str
+    data_stall_frac: Optional[float] = None
+    goodput_frac: Optional[float] = None
+    mfu: Optional[float] = None
+    active_alerts: Tuple[str, ...] = ()
+    heartbeat_age_s: Optional[float] = None
+    alive: Optional[bool] = None  # None = no liveness source configured
+    epoch: Optional[float] = None
+
+    def to_record(self) -> dict:
+        out = {
+            k: v
+            for k, v in dataclasses.asdict(self).items()
+            if k != "run" and v is not None and v != ()
+        }
+        if self.active_alerts:
+            out["active_alerts"] = list(self.active_alerts)
+        return out
+
+
+def read_signals(
+    run: str,
+    metrics_file: str,
+    heartbeat_file: Optional[str] = None,
+    now: Optional[float] = None,
+) -> RunSignals:
+    """Scrape one run's last OpenMetrics exposition (and optionally its
+    heartbeat) into :class:`RunSignals`. Pure file reads — an absent or
+    torn exposition degrades to all-None signals, never raises."""
+    vals = export_lib.scrape(textfile=metrics_file) or {}
+
+    def gauge(raw: str) -> Optional[float]:
+        return vals.get(export_lib.metric_name(raw))
+
+    alerts = tuple(export_lib.active_labels(vals))
+    age = None
+    alive: Optional[bool] = None
+    if heartbeat_file is not None:
+        from tpu_dist.obs import heartbeat as heartbeat_lib  # stdlib-only
+
+        rec = heartbeat_lib.read(heartbeat_file)
+        if rec is None:
+            alive = False  # absent beat on a run we were told beats
+        else:
+            ts = rec.get("ts")
+            if isinstance(ts, (int, float)):
+                age = (time.time() if now is None else now) - float(ts)
+                alive = age <= STALE_AFTER_S
+    return RunSignals(
+        run=run,
+        data_stall_frac=gauge("train.data_stall_frac"),
+        goodput_frac=gauge("goodput.goodput_frac"),
+        mfu=gauge("train.mfu"),
+        active_alerts=alerts,
+        heartbeat_age_s=round(age, 1) if age is not None else None,
+        alive=alive,
+        epoch=gauge("train.epoch"),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetPolicy:
+    """The arbitration thresholds (docs/resilience.md for semantics)."""
+
+    donate_stall_frac: float = 0.40   # a run stalled past this donates
+    receive_stall_frac: float = 0.10  # a recipient must be under this
+    hysteresis: float = 0.05          # extra margin to reverse a move
+    move_cooldown: int = 2            # ticks a moved run sits out
+
+    def __post_init__(self):
+        if not 0.0 <= self.receive_stall_frac < self.donate_stall_frac <= 1.0:
+            raise ValueError(
+                "need 0 <= receive_stall_frac < donate_stall_frac <= 1 "
+                f"(got {self.receive_stall_frac} / {self.donate_stall_frac})"
+            )
+        if self.hysteresis < 0 or self.move_cooldown < 0:
+            raise ValueError("hysteresis and move_cooldown must be >= 0")
+
+
+class FleetScheduler:
+    """Gang-schedule N runs on one pod and arbitrate their chips.
+
+    ``fleet_dir`` (optional) is where the actuator lives: each run's
+    allocation file at ``<fleet_dir>/<run>/allocation`` and the audit
+    log at ``<fleet_dir>/fleet.jsonl``. Constructed without it, the
+    scheduler is a pure policy object (the unit-test mode).
+    """
+
+    def __init__(
+        self,
+        runs: List[RunSpec],
+        *,
+        policy: Optional[FleetPolicy] = None,
+        fleet_dir: Optional[str] = None,
+        total_chips: Optional[int] = None,
+        allocations: Optional[Dict[str, int]] = None,
+    ):
+        if not runs:
+            raise ValueError("a fleet needs at least one run")
+        names = [r.name for r in runs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate run names: {names}")
+        self.specs: Dict[str, RunSpec] = {r.name: r for r in runs}
+        self.policy = policy or FleetPolicy()
+        self.fleet_dir = fleet_dir
+        self.alloc: Dict[str, int] = {}
+        for r in runs:
+            a = (allocations or {}).get(r.name, r.original)
+            if a not in feasible_sizes(r.original) or a < r.min_procs:
+                raise ValueError(
+                    f"{r.name}: allocation {a} is not a feasible size of "
+                    f"{r.original} (or under min_procs {r.min_procs})"
+                )
+            self.alloc[r.name] = a
+        allocated = sum(self.alloc.values())
+        self.total_chips = (
+            int(total_chips) if total_chips is not None else allocated
+        )
+        if self.total_chips < allocated:
+            raise ValueError(
+                f"total_chips {self.total_chips} < initial allocations "
+                f"{allocated}"
+            )
+        self.free = self.total_chips - allocated
+        # chips freed by a donation are PENDING until the next tick: the
+        # donor needs its SIGTERM->checkpoint->relaunch window to actually
+        # vacate them, and granting in the same instant would transiently
+        # oversubscribe the pool (the recipient's probe can fire first
+        # and relaunch onto chips the donor still holds). Decision points
+        # are epoch-grain and the donor's resize completes within a probe
+        # interval, so one-tick maturation closes the window.
+        self.pending = 0
+        self._pending_since: Optional[int] = None
+        self._last_move_tick: Dict[str, int] = {}
+        self._last_move_dir: Dict[str, str] = {}  # 'donated' | 'received'
+        self.decisions = 0
+        if fleet_dir:
+            os.makedirs(fleet_dir, exist_ok=True)
+            for name, a in self.alloc.items():
+                capacity_lib.write_allocation(self.allocation_path(name), a)
+        self._publish_gauges()
+
+    # -- paths ---------------------------------------------------------------
+
+    def allocation_path(self, run: str) -> str:
+        if not self.fleet_dir:
+            raise ValueError("scheduler constructed without a fleet_dir")
+        return os.path.join(self.fleet_dir, run, "allocation")
+
+    def history_path(self) -> str:
+        if not self.fleet_dir:
+            raise ValueError("scheduler constructed without a fleet_dir")
+        return os.path.join(self.fleet_dir, "fleet.jsonl")
+
+    # -- policy --------------------------------------------------------------
+
+    def _in_cooldown(self, run: str, tick: int) -> bool:
+        last = self._last_move_tick.get(run)
+        return last is not None and tick - last <= self.policy.move_cooldown
+
+    def _donor_ok(self, run: str, sig: Optional[RunSignals], tick: int) -> bool:
+        spec = self.specs[run]
+        if self.alloc[run] <= spec.min_procs:
+            return False
+        if shrink_target(
+            spec.original, self.alloc[run], self.alloc[run] - 1, spec.min_procs
+        ) is None:
+            return False
+        if self._in_cooldown(run, tick):
+            return False
+        if sig is None or sig.alive is False:
+            return False
+        stall = sig.data_stall_frac
+        if stall is None:
+            return False
+        threshold = self.policy.donate_stall_frac
+        if self._last_move_dir.get(run) == "received":
+            # hysteresis: reversing a receive needs extra conviction
+            threshold += self.policy.hysteresis
+        return stall >= threshold
+
+    def _recipient_ok(self, run: str, sig: Optional[RunSignals], tick: int) -> bool:
+        spec = self.specs[run]
+        if self.alloc[run] >= spec.original:
+            return False
+        if self._in_cooldown(run, tick):
+            return False
+        if sig is None or sig.alive is False:
+            return False
+        if sig.active_alerts:
+            return False  # alert-veto: never feed chips to a sick run
+        stall = sig.data_stall_frac
+        if stall is None:
+            return False
+        threshold = self.policy.receive_stall_frac
+        if self._last_move_dir.get(run) == "donated":
+            threshold -= self.policy.hysteresis
+        return stall <= threshold
+
+    def mature_pending(self, tick: int) -> None:
+        """Fold chips a donor freed at an EARLIER tick into the grantable
+        pool — by the next epoch-grain decision point the donor's probe
+        has long since relaunched it at the smaller size, so the chips
+        are genuinely vacant. :meth:`step` calls this; drive it yourself
+        when using :meth:`decide`/:meth:`apply` directly."""
+        if self._pending_since is not None and tick > self._pending_since:
+            self.free += self.pending
+            self.pending = 0
+            self._pending_since = None
+            self._publish_gauges()
+
+    def decide(
+        self, tick: int, signals: Dict[str, RunSignals]
+    ) -> List[dict]:
+        """One decision point: pure policy over the scraped signals (no
+        state mutated — :meth:`step` applies + audits). At most one
+        decision per tick (epoch-grain pacing; the cooldown makes more
+        pointless anyway): a **grant** grows the best compute-bound
+        recipient from the FREE pool; when the pool is empty a
+        **donation** shrinks the worst stalled donor, banking its chips
+        as pending until the next tick — never both at once, so the
+        allocations on disk never sum past the chips that are actually
+        vacant (the donor needs its checkpoint/relaunch window to vacate
+        them)."""
+        donors = sorted(
+            (r for r in self.specs if self._donor_ok(r, signals.get(r), tick)),
+            key=lambda r: (-(signals[r].data_stall_frac or 0.0), r),
+        )
+        recipients = sorted(
+            (r for r in self.specs
+             if self._recipient_ok(r, signals.get(r), tick)),
+            key=lambda r: (signals[r].data_stall_frac or 0.0, r),
+        )
+        recipients = [r for r in recipients if r not in donors]
+        for recipient in recipients:
+            spec = self.specs[recipient]
+            cur = self.alloc[recipient]
+            target = grow_target(
+                spec.original, cur, cur + self.free, spec.original
+            )
+            if target is not None:
+                return [self._grant_decision(
+                    tick, signals, recipient, target
+                )]
+            # the recipient is starved and the pool is dry: bank the
+            # worst donor's chips for the NEXT tick (a donation without
+            # demand never happens — chips would just idle)
+            for donor in donors:
+                dspec = self.specs[donor]
+                dcur = self.alloc[donor]
+                dtarget = shrink_target(
+                    dspec.original, dcur, dcur - 1, dspec.min_procs
+                )
+                if dtarget is None:
+                    continue
+                freed = dcur - dtarget
+                if grow_target(
+                    spec.original, cur,
+                    cur + self.free + self.pending + freed, spec.original,
+                ) is None:
+                    continue  # the donation would never reach a feasible grow
+                return [self._donate_decision(
+                    tick, signals, donor, dtarget, for_run=recipient
+                )]
+        return []
+
+    def _base_record(self, tick: int, signals: Dict[str, RunSignals]) -> dict:
+        return {
+            "kind": "fleet",
+            "schema_version": FLEET_SCHEMA_VERSION,
+            "tick": int(tick),
+            "inputs": {
+                r: signals[r].to_record() for r in sorted(signals)
+            },
+            "policy": dataclasses.asdict(self.policy),
+        }
+
+    def _grant_decision(
+        self, tick: int, signals: Dict[str, RunSignals],
+        recipient: str, recipient_to: int,
+    ) -> dict:
+        before = dict(self.alloc)
+        after = dict(before)
+        after[recipient] = recipient_to
+        moved = recipient_to - before[recipient]
+        rsig = signals.get(recipient)
+        return {
+            **self._base_record(tick, signals),
+            "action": "grant",
+            "donor": None,
+            "recipient": recipient,
+            "chips": int(moved),
+            "alloc_before": before,
+            "alloc_after": after,
+            "free_before": self.free,
+            "free_after": self.free - moved,
+            "pending_after": self.pending,
+            "reason": "free pool staffs compute-bound "
+            + recipient
+            + (
+                f" (stall {rsig.data_stall_frac:.0%})"
+                if rsig is not None and rsig.data_stall_frac is not None
+                else ""
+            ),
+        }
+
+    def _donate_decision(
+        self, tick: int, signals: Dict[str, RunSignals],
+        donor: str, donor_to: int, for_run: str,
+    ) -> dict:
+        before = dict(self.alloc)
+        after = dict(before)
+        after[donor] = int(donor_to)
+        freed = before[donor] - after[donor]
+        dsig = signals.get(donor)
+        fsig = signals.get(for_run)
+        return {
+            **self._base_record(tick, signals),
+            "action": "donate",
+            "donor": donor,
+            "recipient": None,
+            "for_run": for_run,
+            "chips": int(freed),
+            "alloc_before": before,
+            "alloc_after": after,
+            "free_before": self.free,
+            "free_after": self.free,
+            "pending_after": self.pending + freed,
+            "reason": (
+                f"{donor} "
+                + (
+                    f"{dsig.data_stall_frac:.0%} "
+                    if dsig is not None and dsig.data_stall_frac is not None
+                    else ""
+                )
+                + f"data-stalled donates {freed} chip(s) toward "
+                f"compute-bound {for_run}"
+                + (
+                    f" (stall {fsig.data_stall_frac:.0%})"
+                    if fsig is not None and fsig.data_stall_frac is not None
+                    else ""
+                )
+                + " — grantable next tick"
+            ),
+        }
+
+    # -- actuation + audit ---------------------------------------------------
+
+    def apply(self, decision: dict, tick: int) -> None:
+        """Commit one decision: allocations, cooldown/hysteresis state,
+        pending/free pools, gauges, allocation files."""
+        after = decision["alloc_after"]
+        for run in self.specs:
+            if after[run] != self.alloc[run]:
+                self._last_move_tick[run] = tick
+                self._last_move_dir[run] = (
+                    "donated" if after[run] < self.alloc[run] else "received"
+                )
+                self.alloc[run] = after[run]
+                if self.fleet_dir:
+                    capacity_lib.write_allocation(
+                        self.allocation_path(run), after[run]
+                    )
+        self.free = decision["free_after"]
+        if decision.get("action") == "donate":
+            self.pending = decision["pending_after"]
+            self._pending_since = tick
+        self.decisions += 1
+        counters_lib.inc("fleet.decisions")
+        self._publish_gauges()
+
+    def step(
+        self,
+        tick: int,
+        signals: Dict[str, RunSignals],
+        ts: Optional[float] = None,
+    ) -> List[dict]:
+        """mature pending → decide → apply → audit. ``ts`` annotates the
+        record for humans and cross-run joins; the POLICY never reads it
+        (reproducibility contract)."""
+        self.mature_pending(tick)
+        decisions = self.decide(tick, signals)
+        for d in decisions:
+            self.apply(d, tick)
+            if self.fleet_dir:
+                rec = dict(d)
+                rec["ts"] = time.time() if ts is None else ts
+                with open(self.history_path(), "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+        return decisions
+
+    def _publish_gauges(self) -> None:
+        for run, a in self.alloc.items():
+            counters_lib.set_gauge(f"fleet.allocation.{run}", a)
+        counters_lib.set_gauge("fleet.free_chips", self.free)
+        counters_lib.set_gauge("fleet.pending_chips", self.pending)
+
+    def exposition(self) -> str:
+        """The scheduler's own OpenMetrics exposition:
+        ``tpu_dist_fleet_allocation{run="..."}`` samples plus the
+        decision counter — scrape-able next to the runs it arbitrates."""
+        return export_lib.render(
+            {
+                "fleet.decisions": self.decisions,
+                "fleet.free_chips": self.free,
+                "fleet.pending_chips": self.pending,
+            },
+            labeled={"fleet_allocation": dict(self.alloc)},
+            label_keys={"fleet_allocation": "run"},
+        )
+
+    def write_exposition(self, path: str) -> None:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(tmp, "w") as f:
+            f.write(self.exposition())
+        os.replace(tmp, path)

@@ -27,7 +27,10 @@ fired: rule/metric/value/threshold/sustained, ``obs/alerts.py``); v6
 added the analytics layer — the ``profile_analysis`` kind (per-capture
 device-time attribution read back from the trace by ``obs/xprof.py``:
 category seconds, collectives by kind, comm/compute overlap fraction,
-infeed stall, top ops, cost-model ``calibration`` gauges)
+infeed stall, top ops, cost-model ``calibration`` gauges); v7 added the
+elastic layer — the ``resume`` segment-boundary kind; v8 added the fleet
+layer — the ``fleet`` kind (a scheduler chip-move decision with the
+allocations before/after and the scraped signals that justified it)
 (docs/observability.md). Consumers (``obs summarize``/``compare``) read
 all versions: every addition is a new kind or optional field, never a
 changed one, and readers skip-with-count kinds they don't know — so a
@@ -50,9 +53,12 @@ import jax
 
 from tpu_dist.obs import counters as counters_lib
 
-SCHEMA_VERSION = 7  # v7 (additive): 'resume' segment-boundary records
-#                     (world size, elastic reshard flag, re-entry position
-#                     — docs/resilience.md "Elastic training")
+SCHEMA_VERSION = 8  # v8 (additive): 'fleet' scheduler-decision records
+#                     (chip moves between runs sharing a pod, with the
+#                     scraped inputs that justified them — docs/
+#                     resilience.md "Scale-up & fleet scheduling"); v7
+#                     added 'resume' segment-boundary records (world
+#                     size, elastic reshard flag, re-entry position)
 
 
 class MetricsHistory:

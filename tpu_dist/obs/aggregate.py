@@ -156,6 +156,8 @@ def pod_report(
             # is NOT fixed across segments — surface world-size changes
             "resumes": rep.get("resumes", []),
             "world_sizes": rep.get("world_sizes", []),
+            # fleet-scheduler chip moves (schema v8) found in this log
+            "fleet_decisions": rep.get("fleet_decisions", []),
         })
     fracs = [
         h["goodput"]["goodput_frac"] for h in hosts
@@ -239,12 +241,28 @@ def format_text(report: dict) -> str:
     for h in report["hosts"]:
         ws = h.get("world_sizes") or []
         if len(ws) > 1:
+            resumes = h.get("resumes", [])
+            grows = sum(
+                1 for r in resumes
+                if goodput_lib.resume_direction(r) == "grown"
+            )
             lines.append(
                 f"elastic on {h['host']}: world size dp "
                 + " -> ".join(str(x) for x in ws)
                 + " ("
-                + str(sum(1 for r in h.get("resumes", []) if r.get("resharded")))
-                + " resharded resume(s)) — host set not fixed across segments"
+                + str(sum(1 for r in resumes if r.get("resharded")))
+                + " resharded resume(s)"
+                + (f", {grows} grow(s)" if grows else "")
+                + ") — host set not fixed across segments"
+            )
+    # fleet-scheduler decisions: chips moved BETWEEN runs on this pod —
+    # the arbitration audit trail, rendered next to the runs it moved
+    for h in report["hosts"]:
+        for fd in h.get("fleet_decisions", []):
+            lines.append(
+                f"fleet ({h['host']}) tick {fd.get('tick')}: "
+                + goodput_lib.fleet_move_phrase(fd)
+                + (f" — {fd['reason']}" if fd.get("reason") else "")
             )
     # per-host profiler captures: paths + the xprof analysis rollup, so
     # the pod view answers WHERE each capture lives and WHAT it said —

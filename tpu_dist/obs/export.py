@@ -84,11 +84,14 @@ def _fmt_value(v: float) -> str:
 def render(
     values: Dict[str, float],
     labeled: Optional[Dict[str, Dict[str, float]]] = None,
+    label_keys: Optional[Dict[str, str]] = None,
 ) -> str:
     """Render one exposition: ``values`` maps raw (dotted) metric names to
     numbers; ``labeled`` maps raw names to ``{label_value: number}``
-    samples emitted as ``name{rule="..."}`` (the alert gauges).  Non-
-    numeric registry entries (info gauges — run id, mode strings) are
+    samples emitted as ``name{<key>="..."}`` — the label key per family
+    comes from ``label_keys`` and defaults to ``rule`` (the alert gauges,
+    the original labeled family; the fleet scheduler passes ``run``).
+    Non-numeric registry entries (info gauges — run id, mode strings) are
     skipped: OpenMetrics samples are numbers.  Ends with the mandatory
     ``# EOF``."""
     lines = []
@@ -101,10 +104,11 @@ def render(
         lines.append(f"{name} {_fmt_value(v)}")
     for raw in sorted(labeled or {}):
         name = metric_name(raw)
+        key = (label_keys or {}).get(raw, "rule")
         lines.append(f"# TYPE {name} gauge")
         for label, v in sorted((labeled or {})[raw].items()):
             safe = str(label).replace("\\", "\\\\").replace('"', '\\"')
-            lines.append(f'{name}{{rule="{safe}"}} {_fmt_value(v)}')
+            lines.append(f'{name}{{{key}="{safe}"}} {_fmt_value(v)}')
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
@@ -252,6 +256,29 @@ class MetricsExporter:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def active_labels(
+    vals: Dict[str, float], family: str = "alert_active"
+) -> list:
+    """Label values of ``family``'s nonzero samples in a scraped
+    exposition (``{name{key="label"}: value}`` as :func:`parse` returns
+    them), sorted — e.g. the firing alert-rule names. ONE home for the
+    label-grammar parsing the launcher watchdog and the fleet scheduler
+    both read back."""
+    prefix = metric_name(family) + "{"
+    out = []
+    for name, v in vals.items():
+        if not name.startswith(prefix) or not v:
+            continue
+        parts = name[len(prefix):].split('"')
+        # parse() admits any `name{...} value` line, quoted or not — a
+        # foreign/hand-written sample without a quoted label must be
+        # skipped, not crash the scraper (read_signals' never-raises
+        # contract, and the watchdog's sick-report shares this helper)
+        if len(parts) >= 2:
+            out.append(parts[1])
+    return sorted(out)
 
 
 def scrape(

@@ -137,6 +137,45 @@ class GoodputLedger:
         return out
 
 
+def resume_direction(rec: dict) -> Optional[str]:
+    """Classify a ``resume`` record's elastic direction — ONE home for
+    the ``prev_dp``/``dp`` comparison every consumer renders or charges
+    by (this ledger, ``summarize``, ``tail``, ``pod``):
+
+    * ``'grown'`` — the world got BIGGER (scale-up / fleet receipt),
+    * ``'resharded'`` — any other elastic resize: a shrink, or a
+      same-size restore whose dp-dependent leaves were re-laid,
+    * ``None`` — a plain same-world resume (no elastic resize at all).
+    """
+    prev_dp, dp = rec.get("prev_dp"), rec.get("dp")
+    ints = isinstance(prev_dp, int) and isinstance(dp, int)
+    if ints and dp > prev_dp:
+        return "grown"
+    if rec.get("resharded") or (ints and dp != prev_dp):
+        return "resharded"
+    return None
+
+
+def fleet_move_phrase(rec: dict) -> str:
+    """The "who → whom" phrase of a ``fleet`` decision record — ONE home
+    for the three renderers (``summarize``, ``tail``, ``pod``). Handles
+    a grant (no donor: chips from the free pool), a donation (no
+    recipient: chips bank as pending for ``for_run``), and the paired
+    form foreign tooling may still write."""
+    donor, recipient = rec.get("donor"), rec.get("recipient")
+    if donor and recipient:
+        phrase = f"{donor} -> {recipient}"
+    elif recipient:
+        phrase = f"free pool -> {recipient}"
+    elif donor:
+        phrase = f"{donor} -> pending pool"
+        if rec.get("for_run"):
+            phrase += f" (toward {rec['for_run']})"
+    else:
+        phrase = "?"
+    return phrase + f" ({rec.get('chips')} chip(s))"
+
+
 # -- offline: fold a run's JSONL records back into one ledger ---------------
 
 
@@ -158,12 +197,16 @@ def run_ledger(records: List[dict]) -> Optional[dict]:
     gap between a segment's LAST record and the next segment's
     construction instant (its first record's ``ts - rel_s``) is the
     restart loss nobody inside either process could see — it lands in
-    ``preempt_s``, except when the new segment opens with a RESHARDED
-    ``resume`` record (an elastic shrink/grow, schema v7): that gap is
-    the reshard+relaunch cost of keeping the run alive at a new world
-    size and is charged to ``recovery_s`` instead (docs/resilience.md
-    "Elastic training"). Returns None when the log holds no goodput
-    records (an old-schema log)."""
+    ``preempt_s``, except when the new segment opens with an ELASTIC
+    ``resume`` record: one flagged resharded, or one whose world size
+    changed (``prev_dp != dp`` — a probe-triggered grow or a
+    scheduler-initiated donation can re-lay zero leaves when the padded
+    lengths happen to agree, and a voluntary resize must never inflate
+    ``preempt_s``). That gap is the reshard/resize+relaunch cost of
+    keeping the run alive at a new world size and is charged to
+    ``recovery_s`` instead (docs/resilience.md "Elastic training" /
+    "Scale-up & fleet scheduling"). Returns None when the log holds no
+    goodput records (an old-schema log)."""
     totals = _zero_totals()
     n_segments = 0
     saw_goodput = False
@@ -210,7 +253,10 @@ def run_ledger(records: List[dict]) -> Optional[dict]:
                 and isinstance(rel, (int, float))
             ):
                 gap = max(float(ts) - float(rel) - last_ts, 0.0)
-                if rec.get("kind") == "resume" and rec.get("resharded"):
+                if (
+                    rec.get("kind") == "resume"
+                    and resume_direction(rec) is not None
+                ):
                     reshard_gap_s += gap
                 else:
                     restart_s += gap

@@ -29,14 +29,14 @@ from tpu_dist.obs import goodput as goodput_lib
 #: kinds summarized; their unknown kinds are skipped with a count — the
 #: forward-compat contract that lets v3 tooling read v4 logs and vice
 #: versa (every schema bump is additive).
-SUPPORTED_SCHEMA = 7
+SUPPORTED_SCHEMA = 8
 
 #: Record kinds this reader folds into the report. Anything else is
 #: counted into ``skipped_kinds`` — never an error, never silent.
 KNOWN_KINDS = frozenset((
     "train_epoch", "eval", "straggler", "anomaly", "device_stats",
     "auto_recover", "spans", "goodput", "profile", "alert",
-    "profile_analysis", "resume",
+    "profile_analysis", "resume", "fleet",
 ))
 
 
@@ -75,6 +75,7 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
     goodput_epochs: List[dict] = []
     resumes: List[dict] = []  # segment boundaries (world size, reshard)
     world_sizes: List[int] = []  # distinct dp extents, in order of appearance
+    fleet_decisions: List[dict] = []  # scheduler chip moves (schema v8)
     dstats: dict = {}  # epoch -> per-epoch device_stats aggregate
     recoveries = 0
     prev_counters: Optional[dict] = None
@@ -160,6 +161,17 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
                 not world_sizes or world_sizes[-1] != dp
             ):
                 world_sizes.append(dp)
+        elif kind == "fleet":
+            # a fleet-scheduler decision (schema v8): auditable chip move
+            # between runs sharing the pod — keep the justification AND
+            # the allocations so the report replays the arbitration
+            fleet_decisions.append({
+                k: rec.get(k)
+                for k in ("tick", "action", "donor", "recipient", "for_run",
+                          "chips", "alloc_before", "alloc_after",
+                          "pending_after", "reason", "inputs")
+                if rec.get(k) is not None
+            })
         elif kind == "profile":
             profiles.append({
                 k: rec.get(k)
@@ -248,6 +260,7 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
         "partial_epoch_device_stats": partial,
         "resumes": resumes,
         "world_sizes": world_sizes,
+        "fleet_decisions": fleet_decisions,
         "stragglers": stragglers,
         "anomalies": anomalies,
         "alerts": alerts,
@@ -309,16 +322,36 @@ def format_text(report: dict) -> str:
             else f" at example offset {rs['examples_offset']}"
             if rs.get("examples_offset") else ""
         )
+        # world-size INCREASE (scale-up / fleet receipt) labeled
+        # distinctly from the preemption-shrink reshard — one shared
+        # classifier: goodput.resume_direction
+        direction = goodput_lib.resume_direction(rs)
         lines.append(
             f"segment: resumed epoch {rs.get('epoch')}{pos} on "
             f"{rs.get('world')} process(es), dp={rs.get('dp')}"
             + (
-                f" (RESHARDED from dp={rs.get('prev_dp')})"
-                if rs.get("resharded") else ""
+                f" ({'GROWN' if direction == 'grown' else 'RESHARDED'}"
+                f" from dp={rs.get('prev_dp')})"
+                if direction else ""
             )
             + (
                 f" — elastic restart #{rs['restarts']}"
                 if rs.get("restarts") else ""
+            )
+        )
+    for fd in report.get("fleet_decisions", []):
+        lines.append(
+            f"fleet: tick {fd.get('tick')}: "
+            + goodput_lib.fleet_move_phrase(fd)
+            + (f" — {fd['reason']}" if fd.get("reason") else "")
+            + (
+                " [alloc "
+                + ", ".join(
+                    f"{r}:{fd['alloc_before'][r]}->{fd['alloc_after'][r]}"
+                    for r in sorted(fd["alloc_before"])
+                )
+                + "]"
+                if fd.get("alloc_before") and fd.get("alloc_after") else ""
             )
         )
     hdr = (

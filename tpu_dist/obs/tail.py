@@ -26,6 +26,7 @@ import time
 from typing import Dict, List, Optional, TextIO
 
 from tpu_dist.obs import heartbeat as heartbeat_lib
+from tpu_dist.obs.goodput import fleet_move_phrase, resume_direction
 
 #: epochs shown in the rolling table (older rows scroll off — the full
 #: history is what ``summarize`` is for)
@@ -195,18 +196,31 @@ class TailState:
             elif kind == "resume":
                 # segment boundary with world-size context (schema v7):
                 # the host set is not fixed — say which world this
-                # segment runs at and whether the state was resharded
+                # segment runs at and which DIRECTION the resize went
+                # (GROWN = scale-up/fleet receipt, RESHARDED = shrink;
+                # one shared classifier: goodput.resume_direction)
+                direction = resume_direction(rec)
                 self._event(
                     f"resumed epoch {ep} on {rec.get('world')} "
                     f"process(es), dp={rec.get('dp')}"
                     + (
-                        f" — RESHARDED from dp={rec.get('prev_dp')} "
-                        "(elastic)"
-                        if rec.get("resharded") else ""
+                        f" — {'GROWN' if direction == 'grown' else 'RESHARDED'}"
+                        f" from dp={rec.get('prev_dp')} (elastic)"
+                        if direction else ""
                     )
                     + (
                         f", restart #{rec.get('restarts')}"
                         if rec.get("restarts") else ""
+                    )
+                )
+            elif kind == "fleet":
+                # a scheduler decision (schema v8): chips moved between
+                # runs sharing this pod — say who paid and who gained
+                self._event(
+                    "fleet: " + fleet_move_phrase(rec)
+                    + (
+                        f": {rec.get('reason')}"
+                        if rec.get("reason") else ""
                     )
                 )
 

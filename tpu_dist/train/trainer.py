@@ -2099,6 +2099,13 @@ class Trainer:
             )
             return None
         path, epoch, meta, restored, resharded = chosen
+        stamped_dp = (meta.get("elastic") or {}).get("dp")
+        if isinstance(stamped_dp, int) and stamped_dp < self.n_data:
+            # the scale-up half: a resume onto a LARGER extent is a grow
+            # (probe-triggered or fleet-granted) — counted whether or not
+            # the remapper had leaves to re-lay (a run without ZeRO-1/EF
+            # state grows with zero remapped leaves but it still grew)
+            counters_lib.inc("elastic.grows")
         self.state = self._place_state(restored)
         # pick the recovery backoff up from the checkpoint (see _ckpt_meta)
         self._lr_scale = float(meta.get("lr_scale", 1.0))
