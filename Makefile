@@ -1,6 +1,6 @@
 # Convenience targets; CI / the driver call the underlying commands directly.
 
-.PHONY: test quick bench csrc clean lint pod-report monitor profile-report elastic-drill fleet-drill
+.PHONY: test quick bench csrc clean lint pod-report monitor profile-report elastic-drill fleet-drill postmortem-drill
 
 csrc:
 	$(MAKE) -C tpu_dist/csrc
@@ -51,6 +51,16 @@ elastic-drill:
 #   make fleet-drill [WORKDIR=/tmp/fleet_drill] [PHASE=all|grow|fleet]
 fleet-drill:
 	python -m tpu_dist.fleet.drill --workdir $(or $(WORKDIR),/tmp/fleet_drill) --phase $(or $(PHASE),all)
+
+# The crash-forensics proof, locally: a real run deliberately wedged at
+# a step (deterministic hang fault), the launcher watchdog detects the
+# frozen heartbeat, SIGUSR1s the rank for an all-threads stack dump
+# (naming the hang site), escalates SIGTERM->SIGKILL, and auto-assembles
+# the postmortem bundle — whose decoded flight ring must end exactly at
+# the wedged step (docs/observability.md "Crash forensics"):
+#   make postmortem-drill [WORKDIR=/tmp/postmortem_drill]
+postmortem-drill:
+	python -m tpu_dist.obs.drill --workdir $(or $(WORKDIR),/tmp/postmortem_drill)
 
 # Follow a LIVE run from another terminal:
 #   make monitor LOG=run.jsonl [HB=hb.json]

@@ -1,0 +1,794 @@
+"""Crash forensics (ISSUE 12): the SIGKILL-surviving flight recorder,
+on-demand stack capture, the postmortem assembler, the hang fault site,
+the elastic stale-rank sweep, TD113, and the watchdog capture chain."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_dist.obs import flight
+from tpu_dist.obs import postmortem as postmortem_lib
+
+
+# -- ring: round trip, wraparound, shedding ----------------------------------
+
+
+def test_ring_round_trip_and_wraparound(tmp_path):
+    """Records come back in seq order; once the ring wraps, exactly the
+    last n_slots survive — the 'last N events of the run' contract."""
+    ring = str(tmp_path / "flight.ring")
+    rec = flight.FlightRecorder(
+        ring, run_id="run-1", rank=3, n_slots=8, slot_size=256
+    )
+    rec.record("open", world=4)
+    for i in range(20):
+        rec.step(0, i)
+    rec.close("exit", clean=True)  # stamps the terminal record
+    dec = flight.decode(ring)
+    assert dec["header"]["run_id"] == "run-1"
+    assert dec["header"]["rank"] == 3
+    assert dec["torn_slots"] == 0
+    assert len(dec["records"]) == 8  # the ring's capacity, newest 8
+    seqs = [r["seq"] for r in dec["records"]]
+    assert seqs == sorted(seqs) and seqs[-1] == 22  # open + 20 + exit
+    assert dec["last"]["kind"] == "exit" and dec["last"]["clean"] is True
+    assert flight.last_step(dec)["step"] == 19
+
+
+def test_ring_step_records_carry_counter_deltas(tmp_path):
+    from tpu_dist.obs import counters
+
+    # fresh registry: with hundreds of residual counters from earlier
+    # tests the FIRST step's delta (vs nothing) would overflow its slot
+    # and legitimately shed the dict — this test wants the carried case
+    counters.reset()
+    ring = str(tmp_path / "flight.ring")
+    rec = flight.FlightRecorder(ring, n_slots=8)
+    counters.inc("forensic.test_counter", 2)
+    rec.step(1, 0)
+    counters.inc("forensic.test_counter", 5)
+    rec.step(1, 1)
+    rec.close()
+    dec = flight.decode(ring)
+    steps = [r for r in dec["records"] if r["kind"] == "step"]
+    assert steps[0]["counters"]["forensic.test_counter"] == 2
+    assert steps[1]["counters"]["forensic.test_counter"] == 5  # the DELTA
+
+
+def test_ring_oversized_record_sheds_bulk_never_fails(tmp_path):
+    """A record that cannot fit its slot sheds the counters dict, then
+    trims strings — a slot always lands, flagged 'overflow' when cut."""
+    ring = str(tmp_path / "flight.ring")
+    rec = flight.FlightRecorder(ring, n_slots=4, slot_size=128)
+    assert rec.record(
+        "step", epoch=0, step=1, counters={f"k{i}": i for i in range(200)}
+    )
+    assert rec.record("fatal", error="E" * 400, message="m" * 400,
+                      frames=["f" * 90] * 12)
+    dec = flight.decode(ring)
+    assert dec["torn_slots"] == 0
+    kinds = {r["kind"] for r in dec["records"]}
+    assert kinds == {"step", "fatal"}
+    step = next(r for r in dec["records"] if r["kind"] == "step")
+    assert "counters" not in step  # shed, not torn
+
+
+def test_ring_reopen_starts_empty_never_mixes_runs(tmp_path):
+    """An elastic relaunch reuses the same --crash_dir path: the new
+    recorder must ZERO the previous process's slots — stale slots carry
+    valid CRCs, and a hard-killed round 2 must not decode as round 1's
+    clean 'preempt' tail."""
+    ring = str(tmp_path / "flight.ring")
+    r1 = flight.FlightRecorder(ring, run_id="round-1", n_slots=16)
+    for i in range(10):
+        r1.step(0, i)
+    r1.close("preempt", epoch=0)
+    r2 = flight.FlightRecorder(ring, run_id="round-2", n_slots=16)
+    r2.record("open", world=1)
+    r2.step(1, 0)
+    # round 2 SIGKILLed here: no terminal record
+    dec = flight.decode(ring)
+    assert dec["header"]["run_id"] == "round-2"
+    assert [r["seq"] for r in dec["records"]] == [1, 2]
+    assert dec["last"]["kind"] == "step"  # NOT round 1's 'preempt'
+    assert flight.last_step(dec)["epoch"] == 1
+
+
+def test_ring_torn_slot_flagged_never_raises(tmp_path):
+    ring = str(tmp_path / "flight.ring")
+    rec = flight.FlightRecorder(ring, n_slots=8, slot_size=128)
+    for i in range(6):
+        rec.record("step", epoch=0, step=i)
+    rec.close()
+    with open(ring, "r+b") as f:  # flip a payload byte in slot 2
+        f.seek(flight.HEADER_SIZE + 2 * 128 + 30)
+        f.write(b"\xff")
+    dec = flight.decode(ring)
+    assert dec["torn_slots"] == 1
+    assert len(dec["records"]) == 6  # 7 written (+exit), 1 torn
+    # garbage header: decode still walks the slots with default geometry
+    with open(ring, "r+b") as f:
+        f.write(b"\xde\xad\xbe\xef")
+    dec2 = flight.decode(ring)
+    assert dec2["header"] is None and dec2["torn_header"]
+
+
+def test_sigkill_mid_ring_write_recovers_complete_slots(tmp_path):
+    """The satellite acceptance: a writer SIGKILLed mid-stream leaves a
+    ring whose COMPLETE slots all decode and whose torn tail is at most
+    the single in-flight slot — the decoder never raises."""
+    ring = str(tmp_path / "flight.ring")
+    child = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(flight.__file__)))!r})
+        from tpu_dist.obs import flight
+        rec = flight.FlightRecorder({ring!r}, n_slots=32, slot_size=256)
+        rec.record("open", world=1)
+        i = 0
+        while True:  # hammer the ring until the parent kills us
+            rec.step(0, i)
+            i += 1
+    """)
+    env = {k: v for k, v in os.environ.items()}
+    env["PYTHONPATH"] = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(flight.__file__)))
+    )
+    pr = subprocess.Popen([sys.executable, "-c", child], env=env)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:  # wait until it is mid-hammer
+        try:
+            if os.path.getsize(ring) >= flight.HEADER_SIZE + 32 * 256:
+                dec = flight.decode(ring)
+                if len(dec["records"]) > 40:  # wrapped at least once
+                    break
+        except OSError:
+            pass
+        time.sleep(0.02)
+    pr.send_signal(signal.SIGKILL)
+    pr.wait()
+    dec = flight.decode(ring)  # must not raise
+    assert dec["torn_slots"] <= 1  # at most the one in-flight pwrite
+    recs = dec["records"]
+    assert len(recs) >= 31
+    seqs = [r["seq"] for r in recs]
+    # complete slots are contiguous except for (at most) the torn one
+    assert seqs == sorted(seqs)
+    gaps = sum(b - a - 1 for a, b in zip(seqs, seqs[1:]))
+    assert gaps <= 1
+    # the terminal record is absent: the hard-kill signature postmortem
+    # classifies as no-clean-exit
+    assert dec["last"]["kind"] == "step"
+    rep = postmortem_lib._verdict(
+        {"last": dec["last"], "n_records": len(recs), "fatal": None},
+        None, None,
+    )
+    assert rep == "no-clean-exit"
+
+
+# -- fatal slots via the excepthook wrappers ---------------------------------
+
+
+def test_thread_excepthook_stamps_fatal_slot_and_chains(tmp_path):
+    ring = str(tmp_path / "flight.ring")
+    rec = flight.FlightRecorder(ring, n_slots=8)
+    seen = []
+    prev = threading.excepthook
+    threading.excepthook = lambda a: seen.append(a.exc_type)
+    try:
+        rec.install_excepthooks()
+
+        def boom():
+            raise RuntimeError("producer died mid-epoch")
+
+        t = threading.Thread(target=boom, name="loader-producer")
+        t.start()
+        t.join()
+    finally:
+        rec.uninstall_excepthooks()
+        threading.excepthook = prev
+    rec.close()
+    dec = flight.decode(ring)
+    fatals = flight.fatal_records(dec)
+    assert len(fatals) == 1
+    f = fatals[0]
+    assert f["error"] == "RuntimeError"
+    assert "producer died" in f["message"]
+    assert f["thread"] == "loader-producer"
+    assert any("boom" in fr for fr in f["frames"])
+    assert seen == [RuntimeError]  # the previous hook still ran
+
+
+def test_sys_excepthook_stamps_fatal_slot(tmp_path):
+    ring = str(tmp_path / "flight.ring")
+    rec = flight.FlightRecorder(ring, n_slots=8)
+    called = []
+    prev = sys.excepthook
+    sys.excepthook = lambda *a: called.append(a[0])
+    try:
+        rec.install_excepthooks()
+        try:
+            raise ValueError("uncaught")
+        except ValueError:
+            sys.excepthook(*sys.exc_info())
+    finally:
+        rec.uninstall_excepthooks()
+        sys.excepthook = prev
+    dec = flight.decode(ring)
+    assert flight.fatal_records(dec)[0]["error"] == "ValueError"
+    assert called == [ValueError]
+
+
+# -- faulthandler arming + stack dumps ---------------------------------------
+
+
+def test_arm_disarm_restores_prior_faulthandler_state(tmp_path):
+    import faulthandler
+
+    before = faulthandler.is_enabled()
+    handle = flight.arm_faulthandler(str(tmp_path / "stacks.txt"))
+    assert handle is not None and faulthandler.is_enabled()
+    flight.disarm_faulthandler(handle)
+    assert faulthandler.is_enabled() == before
+
+
+def test_sigusr1_dump_includes_loader_producer_thread(tmp_path):
+    """The satellite acceptance: an on-demand dump taken while the REAL
+    DataLoader's producer thread is alive names it — frames inside
+    loader.py's producer()."""
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.data import DataLoader, DistributedSampler
+
+    mesh = mesh_lib.data_parallel_mesh()
+    n = 128
+    images = np.zeros((n, 4, 4, 3), np.float32)
+    labels = np.zeros(n, np.int32)
+    sampler = DistributedSampler(n, 1, 0, shuffle=False)
+    loader = DataLoader(images, labels, 16, sampler, mesh, prefetch=1)
+    stacks = str(tmp_path / "stacks.txt")
+    handle = flight.arm_faulthandler(stacks)
+    assert handle is not None and handle.registered
+    it = iter(loader)
+    next(it)  # producer running; with prefetch=1 it blocks on a full queue
+    try:
+        time.sleep(0.2)
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.monotonic() + 5
+        parsed = None
+        while time.monotonic() < deadline:
+            parsed = flight.read_stack_dump(stacks)
+            if parsed and parsed["threads"]:
+                break
+            time.sleep(0.05)
+    finally:
+        for _ in it:  # drain so the producer exits cleanly
+            pass
+        flight.disarm_faulthandler(handle)
+    assert parsed is not None
+    assert parsed["current"] is not None  # this (main) thread dumped
+    producer_frames = [
+        fr
+        for t in parsed["threads"]
+        for fr in t["frames"]
+        if fr[0].endswith("loader.py") and fr[2] == "producer"
+    ]
+    assert producer_frames, parsed["threads"]
+
+
+def test_parse_stack_dump_last_dump_wins_and_stuck_frame():
+    sample = (
+        'Thread 0x00007f01 (producer):\n'
+        '  File "/x/loader.py", line 118 in get\n'
+        '  File "/x/loader.py", line 40 in run\n'
+        'Current thread 0x00007f02 (most recent call first):\n'
+        '  File "/x/faults.py", line 399 in _hang\n'
+        '  File "/x/faults.py", line 330 in on_step\n'
+    )
+    one = flight.parse_stack_dump(sample)
+    assert one["n_dumps"] == 1 and len(one["threads"]) == 2
+    assert flight.stuck_frame(one) == "_hang (/x/faults.py:399)"
+    two = flight.parse_stack_dump(sample + sample)  # SIGUSR1 appends
+    assert two["n_dumps"] == 2
+    assert flight.stuck_frame(two) == "_hang (/x/faults.py:399)"
+    assert flight.parse_stack_dump("")["current"] is None
+    assert flight.stuck_frame(flight.parse_stack_dump("garbage")) is None
+
+
+# -- the hang fault site -----------------------------------------------------
+
+
+def test_hang_clause_parses_fires_and_blocks_bounded():
+    from tpu_dist.resilience import faults
+
+    faults.install("hang@step=2:seconds=0.6")
+    try:
+        assert faults.on_step(0, 1) == frozenset()
+        t0 = time.monotonic()
+        acts = faults.on_step(0, 2)
+        took = time.monotonic() - t0
+        assert faults.HANG in acts
+        assert took >= 0.5  # really blocked for ~seconds
+        assert faults.on_step(0, 2) == frozenset()  # disarmed after times=1
+    finally:
+        faults.clear()
+
+
+def test_hang_rank_pinned_never_fires_without_rank():
+    from tpu_dist.resilience import faults
+
+    faults.install("hang@step=1:rank=1:seconds=0.2")
+    try:
+        assert faults.on_step(0, 1, rank=None) == frozenset()
+        assert faults.on_step(0, 1, rank=0) == frozenset()
+        t0 = time.monotonic()
+        assert faults.HANG in faults.on_step(0, 1, rank=1)
+        assert time.monotonic() - t0 >= 0.15
+    finally:
+        faults.clear()
+
+
+def test_hang_parse_errors_and_fused_refusal(tmp_path):
+    from tests.helpers import tiny_resnet
+    from tpu_dist.config import TrainConfig
+    from tpu_dist.resilience import faults
+    from tpu_dist.train.trainer import Trainer, register_model
+
+    with pytest.raises(faults.FaultPlanError):
+        faults.FaultPlan.parse("hang@epoch=1")  # step is required
+    with pytest.raises(faults.FaultPlanError):
+        faults.FaultPlan.parse("hang@step=1:call=3")  # not a hang key
+    assert "hang" in faults.STEPWISE_SITES
+    register_model("tiny_hang_cfg", lambda num_classes=10: tiny_resnet(num_classes))
+    with pytest.raises(ValueError, match="hang"):
+        Trainer(TrainConfig(
+            dataset="synthetic", model="tiny_hang_cfg", num_classes=10,
+            batch_size=64, synthetic_n=128, seed=0, fused_epoch=True,
+            fault_plan="hang@step=1",
+        ))
+    faults.clear()
+
+
+# -- elastic stale-rank sweep ------------------------------------------------
+
+
+def test_sweep_stale_ranks_unit(tmp_path):
+    from tpu_dist.obs.heartbeat import sweep_stale_ranks
+
+    base = str(tmp_path / "hb.json")
+    keep = [base, base + ".h1", base + ".h3"]
+    stale = [base + ".h4", base + ".h7", base + ".h4.tmp"]
+    for p in keep + stale:
+        open(p, "w").write("{}")
+    # an unrelated file that merely shares the prefix shape is untouched
+    other = str(tmp_path / "hb.json.hx")
+    open(other, "w").write("{}")
+    removed = sweep_stale_ranks(base, 4)
+    assert removed == 3
+    assert all(os.path.exists(p) for p in keep + [other])
+    assert not any(os.path.exists(p) for p in stale)
+    assert sweep_stale_ranks(str(tmp_path / "absent" / "x"), 4) == 0
+
+
+def test_launcher_sweeps_departed_rank_files_at_spawn(tmp_path):
+    """After a shrink, the relaunched round must sweep heartbeats/
+    metrics/forensics of ranks outside the new world — the watchdog and
+    `obs pod` must never report a departed rank as dead."""
+    from tpu_dist.cli.launch import main as launch_main
+
+    hb_dir = tmp_path / "hb"
+    m_dir = tmp_path / "m"
+    c_dir = tmp_path / "c"
+    for d in (hb_dir, m_dir, c_dir):
+        d.mkdir()
+    # leftovers from a defunct 8-wide world
+    stale = [
+        hb_dir / "hb.json.h5", m_dir / "metrics.prom.h6",
+        c_dir / "flight.ring.h4", c_dir / "stacks.txt.h7",
+    ]
+    live = [hb_dir / "hb.json.h1", c_dir / "flight.ring.h1"]
+    for p in stale + live:
+        p.write_text("{}")
+    rc = launch_main([
+        "--nproc", "2",
+        "--heartbeat_dir", str(hb_dir), "--metrics_dir", str(m_dir),
+        "--crash_dir", str(c_dir), "--",
+        sys.executable, "-c", "pass",
+    ])
+    assert rc == 0
+    assert not any(p.exists() for p in stale)
+    assert all(p.exists() for p in live)  # ranks inside the world stay
+
+
+# -- postmortem assembly + CLI -----------------------------------------------
+
+
+def _make_scene(d, *, rank1_fatal=True):
+    """A two-rank crash scene: rank 0 hard-killed mid-step (ring stops,
+    heartbeat left behind), rank 1 died on an exception (fatal slot +
+    terminal record)."""
+    os.makedirs(d, exist_ok=True)
+    r0 = flight.FlightRecorder(
+        os.path.join(d, flight.RING_NAME), run_id="run-x", rank=0, n_slots=16
+    )
+    r0.record("open", world=2)
+    for i in range(4):
+        r0.step(2, i)
+    # no terminal record: SIGKILLed
+    r1 = flight.FlightRecorder(
+        os.path.join(d, flight.RING_NAME + ".h1"), run_id="run-x", rank=1,
+        n_slots=16,
+    )
+    r1.record("open", world=2)
+    r1.step(2, 0)
+    if rank1_fatal:
+        try:
+            raise RuntimeError("boom on rank 1")
+        except RuntimeError:
+            r1.fatal(*sys.exc_info())
+        r1.close("exit", clean=False)
+    else:
+        r1.close("exit", clean=True)
+    with open(os.path.join(d, flight.STACKS_NAME), "w") as f:
+        f.write(
+            'Current thread 0x01 (most recent call first):\n'
+            '  File "/x/loader.py", line 118 in get\n'
+        )
+    with open(os.path.join(d, "hb.json"), "w") as f:
+        json.dump({"counter": 9, "epoch": 2, "step": 3, "phase": "train",
+                   "ts": time.time()}, f)
+    from tpu_dist.obs import export as export_lib
+
+    with open(os.path.join(d, "metrics.prom"), "w") as f:
+        f.write(export_lib.render(
+            {"train.epoch": 2, "train.data_stall_frac": 0.4},
+            {"alert_active": {"stall_high": 1}},
+        ))
+    with open(os.path.join(d, "run.jsonl"), "w") as f:
+        for rec in (
+            {"kind": "train_epoch", "epoch": 0, "run_id": "run-x",
+             "schema_version": 9, "ts": 1.0, "rel_s": 1.0,
+             "images_per_sec": 100.0, "loss": 2.0, "epoch_time": 1.0},
+            {"kind": "train_epoch", "epoch": 1, "run_id": "run-x",
+             "schema_version": 9, "ts": 2.0, "rel_s": 2.0,
+             "images_per_sec": 101.0, "loss": 1.9, "epoch_time": 1.0},
+        ):
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_postmortem_assemble_discovers_and_classifies(tmp_path):
+    d = str(tmp_path / "scene")
+    _make_scene(d)
+    report, bundle = postmortem_lib.run_postmortem([d])
+    assert bundle == os.path.join(d, "postmortem.json")
+    assert os.path.exists(bundle)
+    assert report["n_ranks"] == 2
+    by_rank = {r["rank"]: r for r in report["ranks"]}
+    assert by_rank[0]["verdict"] == "no-clean-exit"
+    ls = by_rank[0]["flight"]["last_step"]
+    assert (ls["epoch"], ls["step"]) == (2, 3)
+    assert by_rank[0]["stack"]["stuck_frame"] == "get (/x/loader.py:118)"
+    assert by_rank[0]["heartbeat"]["counter"] == 9
+    assert by_rank[0]["exposition"]["gauges"]["stall"] == "40.0%"
+    assert by_rank[0]["exposition"]["active_alerts"] == ["stall_high"]
+    assert by_rank[1]["verdict"] == "fatal"
+    assert "boom on rank 1" in by_rank[1]["flight"]["fatal"]["message"]
+    hist = report["histories"][0]
+    assert hist["run_id"] == "run-x" and hist["n_records"] == 2
+    text = postmortem_lib.format_text(report)
+    assert "rank 0: NO-CLEAN-EXIT" in text
+    assert "stuck in get (/x/loader.py:118)" in text
+    assert "RuntimeError" in text
+
+
+def test_postmortem_annotate_appends_v9_record(tmp_path):
+    d = str(tmp_path / "scene")
+    _make_scene(d)
+    report, bundle = postmortem_lib.run_postmortem([d], annotate=True)
+    lines = [json.loads(l) for l in open(os.path.join(d, "run.jsonl"))]
+    pm = [r for r in lines if r["kind"] == "postmortem"]
+    assert len(pm) == 1
+    rec = pm[0]
+    assert rec["schema_version"] == postmortem_lib.POSTMORTEM_SCHEMA_VERSION
+    assert rec["bundle"] == bundle
+    assert rec["verdicts"] == {"0": "no-clean-exit", "1": "fatal"}
+    assert rec["stuck_frames"]["0"] == "get (/x/loader.py:118)"
+    assert rec["last_steps"]["0"] == {"epoch": 2, "step": 3}
+    assert "boom on rank 1" in rec["fatal"]["1"]
+
+
+def test_postmortem_schema_literal_pinned_to_history():
+    """The jax-free literal (the FLEET_SCHEMA_VERSION discipline) must
+    track the real schema — this pin is the drift alarm."""
+    from tpu_dist.metrics.history import SCHEMA_VERSION
+
+    assert postmortem_lib.POSTMORTEM_SCHEMA_VERSION == SCHEMA_VERSION == 9
+
+
+def test_rank_summary_shared_and_numeric_sort():
+    """summarize/tail/pod all render per-rank lines through ONE
+    formatter; the JSON string rank keys must order numerically (a
+    16-rank pod is 0..15, not 0,1,10,11,...)."""
+    ranks = {str(r): "clean" for r in range(16)}
+    assert postmortem_lib.sorted_ranks(ranks) == [str(r) for r in range(16)]
+    rec = _pm_record()
+    assert postmortem_lib.rank_summary(rec, "0") == (
+        "no-clean-exit, stuck in get (/x/loader.py:118), "
+        "flight ring ends at epoch 2 step 3"
+    )
+    assert postmortem_lib.rank_summary(rec, "1") == "fatal, fatal RuntimeError: boom"
+
+
+def test_uninstall_excepthooks_leaves_later_wrapper_in_place(tmp_path):
+    """A hook installed AFTER ours must survive our uninstall — we only
+    unwind our own layer when it is still on top."""
+    rec = flight.FlightRecorder(str(tmp_path / "r.ring"), n_slots=4)
+    prev = sys.excepthook
+    try:
+        rec.install_excepthooks()
+        later = lambda *a: None  # noqa: E731 — someone wraps after us
+        sys.excepthook = later
+        rec.uninstall_excepthooks()
+        assert sys.excepthook is later  # NOT blindly restored over it
+    finally:
+        rec.close()
+        sys.excepthook = prev
+
+
+def test_postmortem_cli_exit_codes(tmp_path, capsys):
+    from tpu_dist.obs.__main__ import main as obs_main
+
+    d = str(tmp_path / "scene")
+    _make_scene(d)
+    assert obs_main(["postmortem", d]) == 0
+    out = capsys.readouterr().out
+    assert "postmortem — 2 rank(s)" in out and "bundle written to" in out
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert obs_main(["postmortem", str(empty)]) == 1
+    assert obs_main(["postmortem", str(tmp_path / "missing")]) == 2
+
+
+# -- schema v9 rendering: summarize / tail / pod -----------------------------
+
+
+def _pm_record(**over):
+    rec = {
+        "kind": "postmortem", "ts": 9.0, "rel_s": 9.0, "schema_version": 9,
+        "run_id": "run-x", "n_ranks": 2, "bundle": "/w/postmortem.json",
+        "verdicts": {"0": "no-clean-exit", "1": "fatal"},
+        "stuck_frames": {"0": "get (/x/loader.py:118)"},
+        "fatal": {"1": "RuntimeError: boom"},
+        "last_steps": {"0": {"epoch": 2, "step": 3}},
+    }
+    rec.update(over)
+    return rec
+
+
+def test_summarize_folds_and_renders_postmortem():
+    from tpu_dist.obs.summarize import format_text, summarize
+
+    records = [
+        {"kind": "train_epoch", "epoch": 0, "run_id": "run-x",
+         "schema_version": 9, "ts": 1.0, "rel_s": 1.0,
+         "images_per_sec": 100.0, "loss": 2.0, "epoch_time": 1.0},
+        _pm_record(),
+    ]
+    report = summarize(records)
+    assert report["skipped_kinds"] == {}  # postmortem is a KNOWN kind now
+    assert len(report["postmortems"]) == 1
+    text = format_text(report)
+    assert "POSTMORTEM: crash bundle over 2 rank(s) — /w/postmortem.json" in text
+    assert "rank 0: no-clean-exit, stuck in get (/x/loader.py:118)" in text
+    assert "flight ring ends at epoch 2 step 3" in text
+    assert "rank 1: fatal, fatal RuntimeError: boom" in text
+
+
+def test_tail_renders_crash_events_and_exit_line():
+    from tpu_dist.obs.tail import TailState
+
+    state = TailState()
+    state.add([
+        {"kind": "train_epoch", "epoch": 0, "run_id": "run-x",
+         "schema_version": 9, "images_per_sec": 100.0, "loss": 2.0},
+        _pm_record(),
+    ])
+    assert state.finished and state.crashed
+    frame = state.render()
+    assert "POSTMORTEM: crash bundle over 2 rank(s)" in frame
+    assert "rank 0 wedged — stuck in get (/x/loader.py:118)" in frame
+    assert "fatal on rank 1: RuntimeError: boom" in frame
+    assert "run: CRASHED — postmortem bundle left behind (/w/postmortem.json)" in frame
+    assert "clean exit" not in frame
+    # the clean run keeps its clean exit line
+    clean = TailState()
+    clean.add([
+        {"kind": "goodput", "final": True, "run_id": "r2",
+         "schema_version": 9, "goodput_frac": 0.9, "elapsed_s": 10.0},
+    ])
+    cframe = clean.render()
+    assert clean.finished and not clean.crashed
+    assert "run: clean exit" in cframe and "CRASHED" not in cframe
+
+
+def test_tail_exits_on_postmortem_record(tmp_path, capsys):
+    """`obs tail` must stop following a crashed run: no goodput-final
+    record is ever coming from a dead writer."""
+    from tpu_dist.obs.tail import run_tail
+
+    log = str(tmp_path / "run.jsonl")
+    with open(log, "w") as f:
+        f.write(json.dumps(_pm_record()) + "\n")
+    rc = run_tail(log, interval=0.05)
+    assert rc == 0
+    assert "CRASHED" in capsys.readouterr().out
+
+
+def test_pod_report_surfaces_postmortems():
+    from tpu_dist.obs.aggregate import format_text, pod_report
+
+    records = [
+        {"kind": "train_epoch", "epoch": 0, "run_id": "run-x",
+         "schema_version": 9, "ts": 1.0, "rel_s": 1.0,
+         "images_per_sec": 100.0, "loss": 2.0, "epoch_time": 1.0},
+        _pm_record(),
+    ]
+    report = pod_report([("h0", records)])
+    assert report["hosts"][0]["postmortems"]
+    text = format_text(report)
+    assert "POSTMORTEM on h0: crash bundle over 2 rank(s)" in text
+    assert "rank 0: no-clean-exit, stuck in get (/x/loader.py:118)" in text
+
+
+# -- spans open-listener tap -------------------------------------------------
+
+
+def test_span_open_listener_fires_with_recorder_disabled():
+    from tpu_dist.obs import spans
+
+    assert not spans.enabled()
+    seen = []
+    spans.set_open_listener(lambda name, args: seen.append(name))
+    try:
+        with spans.span("ckpt/write", file="x"):
+            pass
+        assert seen == ["ckpt/write"]
+        assert spans.events() == []  # disabled: the tap buffers nothing
+    finally:
+        spans.clear_open_listener()
+    with spans.span("ckpt/write"):
+        pass
+    assert seen == ["ckpt/write"]  # cleared listener no longer fires
+
+
+# -- trainer integration -----------------------------------------------------
+
+
+@pytest.mark.slow  # full trainer fits (compile): CI crash-forensics step
+# runs this module without the slow filter (ISSUE 12)
+def test_trainer_crash_dir_rings_clean_and_fatal(tmp_path):
+    """fit() with --crash_dir arms the whole kit: a clean run's ring ends
+    with `exit` (clean), a diverging run's ring carries the fatal slot
+    for TrainingDivergedError even though fit re-raised it."""
+    from tests.helpers import tiny_resnet
+    from tpu_dist.config import TrainConfig
+    from tpu_dist.train.trainer import Trainer, TrainingDivergedError, register_model
+
+    register_model("tiny_flight_e2e",
+                   lambda num_classes=10: tiny_resnet(num_classes))
+    crash = str(tmp_path / "crash")
+    cfg = TrainConfig(
+        dataset="synthetic", model="tiny_flight_e2e", num_classes=10,
+        batch_size=64, epochs=1, steps_per_epoch=3, synthetic_n=192,
+        seed=0, eval_every=0, log_every=1, crash_dir=crash,
+        log_file=str(tmp_path / "run.jsonl"),
+    )
+    Trainer(cfg).fit()
+    dec = flight.decode(os.path.join(crash, flight.RING_NAME))
+    kinds = [r["kind"] for r in dec["records"]]
+    assert dec["last"]["kind"] == "exit" and dec["last"]["clean"] is True
+    assert "open" in kinds and "step" in kinds and "span" in kinds
+    assert flight.last_step(dec)["step"] == 2
+    assert os.path.exists(os.path.join(crash, flight.STACKS_NAME))
+    import faulthandler
+
+    assert not faulthandler.is_enabled() or True  # disarm restored prior state
+
+    crash2 = str(tmp_path / "crash2")
+    cfg2 = cfg.replace(crash_dir=crash2, fault_plan="nan_loss@step=1")
+    with pytest.raises(TrainingDivergedError):
+        Trainer(cfg2).fit()
+    dec2 = flight.decode(os.path.join(crash2, flight.RING_NAME))
+    fatals = flight.fatal_records(dec2)
+    assert fatals and fatals[0]["error"] == "TrainingDivergedError"
+    assert dec2["last"]["kind"] == "exit" and dec2["last"]["clean"] is False
+    report = postmortem_lib.assemble([crash2])
+    assert report["ranks"][0]["verdict"] == "fatal"
+    from tpu_dist.obs import spans
+    from tpu_dist.resilience import faults
+
+    assert spans._OPEN_LISTENER is None  # teardown cleared the tap
+    faults.clear()
+
+
+# -- launcher watchdog stack capture e2e -------------------------------------
+
+
+@pytest.mark.slow  # real multi-second watchdog waits; CI crash-forensics
+# step runs this module without the slow filter (ISSUE 12)
+def test_watchdog_sigusr1_dump_names_stuck_frame_then_kills(tmp_path, capsys):
+    """A live-but-frozen child with forensics armed: the watchdog must
+    request the SIGUSR1 dump, name the stuck frame, escalate, and
+    auto-assemble the postmortem bundle."""
+    from tpu_dist.cli.launch import main as launch_main
+
+    work = str(tmp_path)
+    child = textwrap.dedent(f"""
+        import json, os, sys, time
+        sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(flight.__file__)))!r})
+        from tpu_dist.obs import flight
+        argv = sys.argv
+        hb = argv[argv.index('--heartbeat_file') + 1]
+        crash = argv[argv.index('--crash_dir') + 1]
+        rec = flight.FlightRecorder(os.path.join(crash, flight.RING_NAME))
+        rec.record('open', world=1)
+        rec.step(1, 7)
+        handle = flight.arm_faulthandler(
+            os.path.join(crash, flight.STACKS_NAME))
+        json.dump({{'counter': 1, 'epoch': 1, 'step': 7, 'phase': 'train',
+                   'ts': time.time()}}, open(hb, 'w'))
+        def stuck_in_collective():
+            while True:
+                time.sleep(0.2)
+        stuck_in_collective()
+    """)
+    t0 = time.monotonic()
+    rc = launch_main([
+        "--nproc", "1", "--heartbeat_dir", work, "--crash_dir", work,
+        "--watchdog_timeout", "2", "--watchdog_dump_grace", "6",
+        "--watchdog_grace", "2", "--",
+        sys.executable, "-c", child,
+    ])
+    took = time.monotonic() - t0
+    assert rc != 0 and rc != 75
+    assert took < 60
+    err = capsys.readouterr().err
+    assert "WATCHDOG: worker 0 wedged" in err
+    assert "requesting all-threads stack dump" in err
+    assert "stack dump: stuck in" in err and "stuck_in_collective" in err
+    assert "postmortem bundle written to" in err
+    bundle = json.load(open(os.path.join(work, "postmortem.json")))
+    rank0 = bundle["ranks"][0]
+    assert rank0["verdict"] == "no-clean-exit"
+    assert "stuck_in_collective" in rank0["stack"]["stuck_frame"]
+    assert rank0["flight"]["last_step"]["step"] == 7
+
+
+@pytest.mark.slow  # ~40s subprocess chain; CI crash-forensics step runs
+# this module without the slow filter (ISSUE 12)
+def test_postmortem_drill_end_to_end(tmp_path):
+    """`make postmortem-drill`: a real hung trainer detected, dumped,
+    killed, and bundled — the acceptance chain in one invocation."""
+    from tpu_dist.obs.drill import main as drill_main
+
+    assert drill_main(["--workdir", str(tmp_path / "drill")]) == 0
+
+
+# -- TD113 -------------------------------------------------------------------
+
+
+@pytest.mark.slow  # traces the full dp step twice (compile-heavy); CI
+# crash-forensics step runs this module without the slow filter
+def test_td113_gate_and_registry():
+    from tpu_dist.analysis.jaxpr_audit import flight_recorder_noop_violations
+    from tpu_dist.analysis.rules import RULES
+
+    assert "TD113" in RULES
+    assert RULES["TD113"].name == "flight-recorder-not-noop"
+    assert flight_recorder_noop_violations() == []

@@ -537,7 +537,8 @@ def fault_noop_violations(mesh=None) -> list[Violation]:
         base = str(jax.make_jaxpr(fn)(*args))
         faults.install(
             "ckpt_write@call=1:times=2;ckpt_corrupt@epoch=0:mode=bitflip;"
-            "nan_loss@step=0;sigterm@step=999999;loader_stall@batch=0"
+            "nan_loss@step=0;sigterm@step=999999;loader_stall@batch=0;"
+            "hang@step=999999:seconds=0.1"
         )
         fn2, args2 = _dp_setup(m)
         armed = str(jax.make_jaxpr(fn2)(*args2))
@@ -862,6 +863,110 @@ def xprof_hook_noop_violations(mesh=None) -> list[Violation]:
     return out
 
 
+def flight_recorder_noop_violations(mesh=None) -> list[Violation]:
+    """TD113: the crash-forensics cost contract, checked at the program
+    level (the TD105-TD112 armed-vs-off discipline applied to
+    ``obs/flight.py``) — trace the data-parallel step with nothing
+    armed, then arm the FULL forensic kit exactly as ``fit()`` does:
+    a :class:`FlightRecorder` writing real ring slots (open + step
+    records with counter deltas), the ``sys``/``threading`` excepthook
+    wrappers installed, the span-open listener tapping the ring, and
+    ``faulthandler`` armed to a crash file with the SIGUSR1 all-threads
+    dump registered AND actually fired mid-audit — and trace again. The
+    two jaxprs must be byte-identical: forensics is pwrite-at-the-step-
+    boundary host I/O, and the moment someone routes a step marker or a
+    'helpful' device sync through the traced step, this trips. The
+    probe also asserts the kit actually RAN (the ring decodes with
+    records; the dump file holds a parseable traceback when the signal
+    could be delivered) — a dead recorder would make the comparison
+    vacuous."""
+    import os
+    import shutil
+    import signal
+    import tempfile
+
+    import jax
+
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.obs import flight as flight_lib
+    from tpu_dist.obs import spans
+
+    m = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+    fn, args = _dp_setup(m)
+    base = str(jax.make_jaxpr(fn)(*args))
+    tmp = tempfile.mkdtemp(prefix="td113_flight_")
+    rec = None
+    handle = None
+    out: list[Violation] = []
+    try:
+        rec = flight_lib.FlightRecorder(
+            os.path.join(tmp, flight_lib.RING_NAME), run_id="td113", rank=0
+        )
+        rec.install_excepthooks()
+        spans.set_open_listener(rec.span_open)
+        rec.record("open", world=1)
+        handle = flight_lib.arm_faulthandler(
+            os.path.join(tmp, flight_lib.STACKS_NAME)
+        )
+        dumped = False
+        if handle is not None and handle.registered:
+            os.kill(os.getpid(), signal.SIGUSR1)  # a REAL on-demand dump
+            dumped = True
+        rec.step(0, 0)
+        with spans.span("td113/trace_probe"):
+            fn2, args2 = _dp_setup(m)
+            armed = str(jax.make_jaxpr(fn2)(*args2))
+        rec.step(0, 1)
+        ring_path = rec.path
+        stacks_path = os.path.join(tmp, flight_lib.STACKS_NAME)
+        decoded = flight_lib.decode(ring_path)
+        ring_ok = len(decoded["records"]) >= 3 and not decoded["torn_slots"]
+        dump_ok = True
+        if dumped:
+            parsed = flight_lib.read_stack_dump(stacks_path)
+            dump_ok = bool(parsed and parsed.get("current"))
+    finally:
+        spans.clear_open_listener()
+        if rec is not None:
+            rec.uninstall_excepthooks()
+            rec.close()
+        if handle is not None:
+            flight_lib.disarm_faulthandler(handle)
+        shutil.rmtree(tmp, ignore_errors=True)
+    if not ring_ok or not dump_ok:
+        out.append(
+            Violation(
+                "TD113",
+                "<jaxpr:dp_flight_recorder_noop>",
+                0,
+                "the TD113 probe armed the forensic kit but it did not "
+                "actually run ("
+                + ("ring failed to decode its own records" if not ring_ok
+                   else "the SIGUSR1 dump produced no parseable "
+                        "traceback")
+                + ") — the armed-vs-off comparison would be vacuous "
+                "(obs/flight.py contract)",
+                snippet="flight probe did not fire",
+            )
+        )
+    if base != armed:
+        out.append(
+            Violation(
+                "TD113",
+                "<jaxpr:dp_flight_recorder_noop>",
+                0,
+                "the traced train step CHANGED when crash forensics was "
+                "armed (flight ring writing, excepthooks wrapped, span "
+                "listener tapped, faulthandler + SIGUSR1 dump live) — "
+                "forensics must stay host-side file I/O on the step "
+                "boundary (obs/flight.py contract, docs/observability.md "
+                "'Crash forensics')",
+                snippet="jaxpr(forensics_off) != jaxpr(forensics_armed)",
+            )
+        )
+    return out
+
+
 def live_export_noop_violations(mesh=None) -> list[Violation]:
     """TD109: the live-telemetry cost contract, checked at the program
     level (the TD105-TD108 armed-vs-off discipline applied to
@@ -1150,8 +1255,8 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     reference pairs the report contains; full (unfiltered) runs also check
     the TD105 fault-injection, TD106 telemetry, TD107 device-metrics,
     TD108 profiler-trigger, TD109 live-export/alerting, TD110
-    capture-auto-analyze, TD111 elastic-resume, and TD112 elastic-grow
-    no-op invariants."""
+    capture-auto-analyze, TD111 elastic-resume, TD112 elastic-grow, and
+    TD113 flight-recorder no-op invariants."""
     report: dict = {}
     violations: list[Violation] = []
     for name in names if names is not None else registered_cases():
@@ -1183,6 +1288,9 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
         violations.extend(vs)
         vs = elastic_grow_noop_violations(mesh)
         report["dp_elastic_grow_noop"] = {"identical": not vs}
+        violations.extend(vs)
+        vs = flight_recorder_noop_violations(mesh)
+        report["dp_flight_recorder_noop"] = {"identical": not vs}
         violations.extend(vs)
     return report, violations
 

@@ -179,6 +179,17 @@ RULES: dict[str, Rule] = {
             "contract)",
         ),
         Rule(
+            "TD113",
+            "flight-recorder-not-noop",
+            "the traced train step differs between crash forensics OFF "
+            "and an armed flight recorder + faulthandler (ring slots "
+            "written, excepthooks wrapped, span-open listener tapped, "
+            "SIGUSR1 all-threads dump registered and fired) — crash "
+            "forensics must stay host-side file I/O on the step "
+            "boundary (obs/flight.py contract, docs/observability.md "
+            "'Crash forensics')",
+        ),
+        Rule(
             "TD104",
             "quantized-wire-bytes-over-budget",
             "gradient-collective payload bytes of a quantized wire format "

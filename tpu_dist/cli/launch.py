@@ -54,6 +54,24 @@ Once a preemption shutdown begins the watchdog stands down: children beat
 once ('preempted') then go silent in the emergency save by design, and
 reclassifying that as a wedge would turn the requeue-75 exit into a crash.
 
+Crash-forensics contract (docs/observability.md "Crash forensics"):
+``--crash_dir`` injects ``--crash_dir`` into every child — each rank
+writes a SIGKILL-surviving flight-recorder ring and arms a faulthandler
+stack-capture file (``tpu_dist/obs/flight.py``). The watchdog then
+upgrades its kill sequence for a live-but-frozen rank: it first sends
+``SIGUSR1`` (the registered all-threads dump), waits up to
+``--watchdog_dump_grace`` for the dump to land, and names the STUCK
+FRAME (loader ``get``, collective dispatch, ckpt write, ...) in the
+wedge report — only then does it escalate SIGTERM→SIGKILL. After a
+wedged round ends, the launcher auto-invokes the postmortem assembler
+(``python -m tpu_dist.obs postmortem``) over the forensics dirs: one
+bundle per incident, plus a ``postmortem`` history record appended to
+the run's JSONL so ``obs tail``/``summarize``/``pod`` render the crash.
+At every round spawn the launcher also sweeps per-rank files of ranks
+OUTSIDE the new world (``heartbeat.sweep_stale_ranks``) — after an
+elastic shrink, a departed rank's lingering heartbeat/metrics/forensics
+files must not read as a dead worker.
+
 Elastic contract (docs/resilience.md "Elastic training"): with
 ``--elastic_min_procs`` set, the launcher becomes its own orchestrator for
 the shrink case. A round that ends preempted (exit 75) or with dead ranks
@@ -181,6 +199,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
              "report WHY it was sick, not just that its beat froze",
     )
     p.add_argument(
+        "--crash_dir", default=None,
+        help="inject --crash_dir <dir> into every child (per-rank "
+             "flight-recorder ring + faulthandler stack file, "
+             "tpu_dist/obs/flight.py); the watchdog then SIGUSR1s a "
+             "wedged rank for an all-threads stack dump and names the "
+             "stuck frame before killing it, and a wedged round is "
+             "auto-assembled into a postmortem bundle "
+             "(docs/observability.md 'Crash forensics')",
+    )
+    p.add_argument(
+        "--watchdog_dump_grace", type=float, default=5.0, metavar="S",
+        help="with --crash_dir: seconds the watchdog waits for a wedged "
+             "rank's SIGUSR1 stack dump to land before escalating to "
+             "SIGTERM (a truly dead interpreter never answers the dump "
+             "signal — the escalation must not wait on it forever)",
+    )
+    p.add_argument(
         "--watchdog_timeout", type=float, default=0.0, metavar="S",
         help="with --heartbeat_dir: a child whose heartbeat counter "
              "stops advancing for S seconds while the process lives is "
@@ -220,6 +255,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # same per-rank scheme as the heartbeat: the trainer derives
         # .h<k> textfiles and the watchdog scrapes them back
         metrics_base = os.path.join(args.metrics_dir, "metrics.prom")
+    if args.crash_dir:
+        # the dir itself is the injected flag: each rank derives its own
+        # ring/stacks files inside it (obs/flight.py naming)
+        os.makedirs(args.crash_dir, exist_ok=True)
 
     live: List[subprocess.Popen] = []  # the CURRENT round's children
     launcher_sig = [False]  # SIGTERM delivered to the LAUNCHER itself
@@ -352,6 +391,30 @@ def _run_round(
         # settle before the census may bounce it again
         probe.reset_timer()
 
+    # elastic-resize hygiene: per-rank files of ranks OUTSIDE this
+    # round's world (heartbeats/metrics/forensics a departed rank left
+    # behind after a shrink) must be swept BEFORE spawning — a lingering
+    # rank-6 heartbeat in a 4-wide world would read as a dead worker to
+    # the watchdog and to `obs pod`
+    from tpu_dist.obs import heartbeat as heartbeat_lib  # noqa: PLC0415
+
+    stale_bases = [b for b in (hb_base, metrics_base) if b]
+    if args.crash_dir:
+        from tpu_dist.obs import flight as flight_lib  # noqa: PLC0415
+
+        stale_bases += [
+            os.path.join(args.crash_dir, flight_lib.RING_NAME),
+            os.path.join(args.crash_dir, flight_lib.STACKS_NAME),
+        ]
+    swept = sum(
+        heartbeat_lib.sweep_stale_ranks(base, nproc) for base in stale_bases
+    )
+    if swept:
+        announce(
+            f"swept {swept} stale per-rank file(s) from ranks outside "
+            f"the new world of {nproc}"
+        )
+
     try:
         for rank in range(nproc):
             env = dict(os.environ)
@@ -382,6 +445,8 @@ def _run_round(
                 child += ["--heartbeat_file", hb_base]
             if metrics_base is not None:
                 child += ["--metrics_file", metrics_base]
+            if args.crash_dir is not None:
+                child += ["--crash_dir", args.crash_dir]
             pr = subprocess.Popen(child, env=env)
             procs.append(pr)
             live.append(pr)
@@ -398,7 +463,30 @@ def _run_round(
         now = time.monotonic()
         wd_seen: Dict[int, tuple] = {ranks[pr]: (None, now) for pr in procs}
         wd_kill_at: Dict[int, float] = {}
+        # stack-capture state (--crash_dir): rank -> [dump deadline,
+        # stack-file size before SIGUSR1, size at the last poll] — the
+        # watchdog waits for the dump to land AND settle before it
+        # parses the appended bytes and escalates
+        wd_dump: Dict[int, list] = {}
+        wedged: List[int] = []  # ranks the watchdog declared wedged
         watchdog = args.watchdog_timeout > 0
+
+        def _stack_path(rank: int) -> Optional[str]:
+            if not args.crash_dir:
+                return None
+            from tpu_dist.obs import flight as flight_lib  # noqa: PLC0415
+            from tpu_dist.obs import heartbeat as heartbeat_lib  # noqa: PLC0415
+
+            return heartbeat_lib.per_rank_path(
+                os.path.join(args.crash_dir, flight_lib.STACKS_NAME), rank
+            )
+
+        def _stack_size(rank: int) -> int:
+            path = _stack_path(rank)
+            try:
+                return os.path.getsize(path) if path else 0
+            except OSError:
+                return 0
 
         def _sick_report(rank: int) -> str:
             """WHY the wedged worker was sick: its last OpenMetrics
@@ -415,21 +503,12 @@ def _run_round(
             )
             if not vals:
                 return ""
-
-            def gauge(raw):
-                return vals.get(export_lib.metric_name(raw))
-
-            parts = []
-            for raw, label, spec in (
-                ("train.epoch", "epoch", "g"),
-                ("train.data_stall_frac", "stall", ".1%"),
-                ("train.mfu", "mfu", ".3f"),
-                ("goodput.goodput_frac", "goodput", ".1%"),
-                ("compile.retraces", "retraces", "g"),
-            ):
-                v = gauge(raw)
-                if v is not None:
-                    parts.append(f"{label} {format(v, spec)}")
+            # ONE gauge set shared with the postmortem assembler
+            # (export.KEY_GAUGES) — the two reads can never drift
+            parts = [
+                f"{label} {v}"
+                for label, v in export_lib.key_gauges(vals).items()
+            ]
             active = export_lib.active_labels(vals)
             if active:
                 parts.append(f"active alerts: {', '.join(active)}")
@@ -454,6 +533,43 @@ def _run_round(
             if rank in wd_kill_at:
                 if t >= wd_kill_at[rank]:
                     pr.kill()  # SIGTERM grace expired — it really is stuck
+                return
+            if rank in wd_dump:
+                # stack capture in flight: wait for the SIGUSR1 dump to
+                # land and settle (two same-size polls), bounded by the
+                # dump grace — a dead interpreter never answers
+                deadline, size0, last_size = wd_dump[rank]
+                size = _stack_size(rank)
+                if t < deadline and (size <= size0 or size != last_size):
+                    wd_dump[rank][2] = size
+                    return
+                from tpu_dist.obs import flight as flight_lib  # noqa: PLC0415
+
+                parsed = (
+                    flight_lib.read_stack_dump(_stack_path(rank), offset=size0)
+                    if size > size0 else None
+                )
+                frame = flight_lib.stuck_frame(parsed) if parsed else None
+                # tpu-dist: ignore[TD002,TD007] — the launcher IS the
+                # single parent process; stderr is its orchestrator
+                # contract (same as the wedge report above)
+                print(
+                    f"launch: WATCHDOG: worker {rank} stack dump: "
+                    + (
+                        f"stuck in {frame} "
+                        f"({len(parsed['threads'])} thread(s) dumped)"
+                        if frame else
+                        "no dump captured (interpreter not answering "
+                        "SIGUSR1 — likely stuck in native code)"
+                    ),
+                    file=sys.stderr, flush=True,
+                )
+                del wd_dump[rank]
+                wd_kill_at[rank] = t + args.watchdog_grace
+                try:
+                    pr.send_signal(signal.SIGTERM)
+                except OSError:  # tpu-dist: ignore[TD006] — child gone
+                    pass
                 return
             rec = heartbeat_lib.read(heartbeat_lib.per_rank_path(hb_base, rank))
             counter = rec.get("counter") if rec else None
@@ -481,6 +597,26 @@ def _run_round(
             )
             if crash_rc == 0:
                 crash_rc = 1  # a wedge is a failure, never a requeue-75
+            wedged.append(rank)
+            if args.crash_dir:
+                # stack capture FIRST: ask the frozen-but-live interpreter
+                # WHERE it is (the rank's faulthandler registered SIGUSR1
+                # as an all-threads dump) — the kill escalation waits for
+                # the answer, bounded by --watchdog_dump_grace
+                size0 = _stack_size(rank)
+                try:
+                    pr.send_signal(signal.SIGUSR1)
+                except OSError:  # tpu-dist: ignore[TD006] — child gone
+                    pass
+                wd_dump[rank] = [t + args.watchdog_dump_grace, size0, size0]
+                # tpu-dist: ignore[TD002,TD007] — launcher stderr contract
+                print(
+                    f"launch: WATCHDOG: requesting all-threads stack dump "
+                    f"from worker {rank} (SIGUSR1), waiting up to "
+                    f"{args.watchdog_dump_grace:.0f}s before escalating",
+                    file=sys.stderr, flush=True,
+                )
+                return
             wd_kill_at[rank] = t + args.watchdog_grace
             try:
                 pr.send_signal(signal.SIGTERM)
@@ -533,6 +669,12 @@ def _run_round(
                     pending[0].wait(timeout=1)
                 except subprocess.TimeoutExpired:
                     pass
+        if wedged and args.crash_dir:
+            # the forensic epilogue: assemble everything the dead world
+            # left behind into ONE bundle + a `postmortem` history record
+            # (obs tail/summarize/pod render it). Never raises — a broken
+            # postmortem must not change the exit-code contract.
+            _auto_postmortem(args, announce, wedged)
         if crash_rc:
             # a crash/wedge outranks a concurrent preemption AND a resize
             # request (the supervisor's failure path must see the real
@@ -554,6 +696,41 @@ def _run_round(
             pr.kill()  # no-op on already-reaped children
             if pr in live:
                 live.remove(pr)
+
+
+def _auto_postmortem(args, say, wedged: List[int]) -> None:
+    """Watchdog epilogue: run the postmortem assembler over every
+    forensics dir this launcher injected, write the bundle, annotate the
+    run's history (when one is discoverable), and summarize the wedged
+    ranks on stderr. Best-effort by contract."""
+    from tpu_dist.obs import postmortem as postmortem_lib  # noqa: PLC0415
+
+    dirs = [
+        d for d in (args.crash_dir, args.heartbeat_dir, args.metrics_dir)
+        if d
+    ]
+    try:
+        report, bundle = postmortem_lib.run_postmortem(dirs, annotate=True)
+    except Exception as e:
+        say(f"postmortem assembly failed: {e}")
+        return
+    if bundle is None:
+        say("postmortem: no forensic artifacts found")
+        return
+    say(f"postmortem bundle written to {bundle}")
+    for r in report["ranks"]:
+        if r["rank"] not in wedged:
+            continue
+        stuck = (r.get("stack") or {}).get("stuck_frame")
+        ls = (r.get("flight") or {}).get("last_step")
+        say(
+            f"postmortem: rank {r['rank']} verdict {r['verdict']}"
+            + (f", stuck in {stuck}" if stuck else "")
+            + (
+                f", flight ring ends at epoch {ls.get('epoch')} step "
+                f"{ls.get('step')}" if ls else ""
+            )
+        )
 
 
 if __name__ == "__main__":

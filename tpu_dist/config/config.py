@@ -145,6 +145,16 @@ class TrainConfig:
                                    # rank-0 warnings, exporter gauge
                                    # flips, and optionally arm the
                                    # triggered profiler (obs/alerts.py)
+    crash_dir: Optional[str] = None  # crash-forensics dir (docs/
+                                   # observability.md "Crash forensics"):
+                                   # per-rank SIGKILL-surviving flight-
+                                   # recorder ring (flight.ring[.h<k>],
+                                   # fixed-slot atomic writes at the step
+                                   # grain) + faulthandler stack-dump
+                                   # file (stacks.txt[.h<k>]: hard-fault
+                                   # tracebacks, SIGUSR1 on-demand
+                                   # all-threads dumps); read back by
+                                   # `python -m tpu_dist.obs postmortem`
     per_host_log: bool = False     # every process writes its own JSONL
                                    # history (<log_file>.h<rank>; rank 0
                                    # keeps the bare path) so `obs pod`
@@ -439,6 +449,17 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "'alert' history records, rank-0 warnings, and "
                         "alert_active exporter gauges; rules with "
                         "profile=true arm the triggered profiler")
+    p.add_argument("--crash_dir", type=str, default=None,
+                   help="crash-forensics directory: every rank writes a "
+                        "SIGKILL-surviving flight-recorder ring "
+                        "(fixed-slot atomic writes — step boundaries, "
+                        "span opens, ckpt/alert/anomaly/resume events, "
+                        "counter deltas, a fatal slot from the excepthook "
+                        "wrappers) plus a faulthandler stack-dump file "
+                        "(hard faults; SIGUSR1 dumps all threads on "
+                        "demand, the launcher watchdog's stack-capture "
+                        "channel). Assemble with `python -m tpu_dist.obs "
+                        "postmortem <dir>` (docs/observability.md)")
     p.add_argument("--per_host_log", action="store_true",
                    help="every process writes its own JSONL history "
                         "(<log_file>.h<rank>; rank 0 keeps the bare path) "

@@ -60,11 +60,11 @@ from tpu_dist.fleet import capacity as capacity_lib
 from tpu_dist.obs import counters as counters_lib
 from tpu_dist.obs import export as export_lib
 
-#: ``fleet`` records stamp the history schema they were introduced in
-#: (metrics/history.py v8 — additive). Kept as a literal so this module
-#: stays jax-free; ``tests/test_fleet.py`` pins it to the real
-#: SCHEMA_VERSION so the two can never drift silently.
-FLEET_SCHEMA_VERSION = 8
+#: ``fleet`` records stamp the CURRENT history schema (metrics/
+#: history.py — v9 after the additive ``postmortem`` kind). Kept as a
+#: literal so this module stays jax-free; ``tests/test_fleet.py`` pins
+#: it to the real SCHEMA_VERSION so the two can never drift silently.
+FLEET_SCHEMA_VERSION = 9
 
 #: Heartbeat older than this reads as a dead/wedged run (matches the
 #: ``obs tail`` STALE threshold and the builtin heartbeat_stale rule).

@@ -30,7 +30,11 @@ category seconds, collectives by kind, comm/compute overlap fraction,
 infeed stall, top ops, cost-model ``calibration`` gauges); v7 added the
 elastic layer — the ``resume`` segment-boundary kind; v8 added the fleet
 layer — the ``fleet`` kind (a scheduler chip-move decision with the
-allocations before/after and the scraped signals that justified it)
+allocations before/after and the scraped signals that justified it); v9
+added the forensics layer — the ``postmortem`` kind (a crash bundle
+assembled from a dead run's leftover files: per-rank verdicts, stuck
+frames, last flight-ring steps — ``obs/postmortem.py``, appended by the
+watchdog's auto-invoke rather than by the dying run itself)
 (docs/observability.md). Consumers (``obs summarize``/``compare``) read
 all versions: every addition is a new kind or optional field, never a
 changed one, and readers skip-with-count kinds they don't know — so a
@@ -53,12 +57,13 @@ import jax
 
 from tpu_dist.obs import counters as counters_lib
 
-SCHEMA_VERSION = 8  # v8 (additive): 'fleet' scheduler-decision records
-#                     (chip moves between runs sharing a pod, with the
-#                     scraped inputs that justified them — docs/
-#                     resilience.md "Scale-up & fleet scheduling"); v7
-#                     added 'resume' segment-boundary records (world
-#                     size, elastic reshard flag, re-entry position)
+SCHEMA_VERSION = 9  # v9 (additive): 'postmortem' crash-bundle records
+#                     (per-rank verdicts, stuck frames, last flight-ring
+#                     steps — appended by the watchdog/CLI assembler,
+#                     docs/observability.md "Crash forensics"); v8 added
+#                     'fleet' scheduler-decision records; v7 added
+#                     'resume' segment-boundary records (world size,
+#                     elastic reshard flag, re-entry position)
 
 
 class MetricsHistory:

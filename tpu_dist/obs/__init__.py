@@ -1,22 +1,25 @@
 """Run telemetry + device-side training health + the fleet layer + the
-LIVE layer + the analytics layer: span tracing, subsystem counters,
-heartbeat, straggler detection, in-step health scalars
-(``device_stats``), cost/MFU accounting and capture calibration
+LIVE layer + the analytics layer + the FORENSICS layer: span tracing,
+subsystem counters, heartbeat, straggler detection, in-step health
+scalars (``device_stats``), cost/MFU accounting and capture calibration
 (``costmodel``), anomaly detection, the goodput ledger (``goodput``),
 triggered device profiling (``profile``), capture read-back analytics
 (``xprof`` — device-time attribution, comm/compute overlap), pod
 aggregation (``aggregate``), OpenMetrics/Prometheus export
-(``export``), declarative threshold alerting (``alerts``), and the
-``python -m tpu_dist.obs summarize`` / ``compare`` / ``pod`` / ``tail``
-/ ``xprof`` CLI.
+(``export``), declarative threshold alerting (``alerts``), crash
+forensics (``flight`` — the SIGKILL-surviving per-rank flight ring +
+faulthandler stack capture; ``postmortem`` — the bundle assembler), and
+the ``python -m tpu_dist.obs summarize`` / ``compare`` / ``pod`` /
+``tail`` / ``xprof`` / ``postmortem`` CLI.
 
-Contract (audited by TD106/TD107/TD108/TD109/TD110): the host-telemetry
-half — goodput ledger, profiler trigger control, capture auto-analysis,
-live exporter, and alert engine included — is host-side only: arming it
-leaves the traced train step byte-identical and adds no per-step device
-transfers. The one deliberately device-side piece, ``device_stats``
-(opt-in ``--device_metrics``), adds zero collectives and rides the
-existing single per-step metrics fetch. See ``docs/observability.md``.
+Contract (audited by TD106/TD107/TD108/TD109/TD110/TD113): the
+host-telemetry half — goodput ledger, profiler trigger control, capture
+auto-analysis, live exporter, alert engine, and the crash-forensics kit
+included — is host-side only: arming it leaves the traced train step
+byte-identical and adds no per-step device transfers. The one
+deliberately device-side piece, ``device_stats`` (opt-in
+``--device_metrics``), adds zero collectives and rides the existing
+single per-step metrics fetch. See ``docs/observability.md``.
 """
 
 from tpu_dist.obs import counters, goodput, spans  # noqa: F401
@@ -52,4 +55,8 @@ def __getattr__(name):
         from tpu_dist.obs.alerts import AlertEngine
 
         return AlertEngine
+    if name == "FlightRecorder":
+        from tpu_dist.obs.flight import FlightRecorder
+
+        return FlightRecorder
     raise AttributeError(f"module 'tpu_dist.obs' has no attribute {name!r}")

@@ -44,6 +44,19 @@ Subcommands::
         Accepts a capture directory (``plugins/profile/...`` inside —
         multi-host trees included) or one Chrome trace file.
 
+    postmortem <dir> [<dir> ...] [--out bundle.json] [--annotate]
+        [--tail N] [--format text|json]
+        Crash forensics (``obs/postmortem.py``): walk the given dirs for
+        per-rank artifacts — SIGKILL-surviving flight rings + stack
+        dumps (``--crash_dir``), left-behind heartbeats, last
+        OpenMetrics expositions, history JSONLs — and fold them into
+        one bundle: decoded ring tails (last step before death), parsed
+        stack dumps (the stuck frame by name), per-rank verdicts
+        (clean / preempted / fatal / no-clean-exit). ``--annotate``
+        appends a ``postmortem`` record to the discovered history (the
+        launcher watchdog's auto-invoke does this). Exit 1 when the
+        dirs hold no forensic artifacts.
+
 Exit codes: 0 ok, 1 empty/unusable input (or, for ``compare``, a
 regression), 2 bad invocation or I/O error.
 The analysis itself is pure file crunching — no device, no backend.
@@ -142,7 +155,59 @@ def main(argv=None) -> int:
     xp.add_argument("--top", type=int, default=10, metavar="K",
                     help="ops listed in the top-self-time table")
     xp.add_argument("--format", choices=("text", "json"), default="text")
+    pm = sub.add_parser(
+        "postmortem",
+        help="assemble per-rank crash-forensics bundles from a run's "
+             "leftover files (flight rings, stack dumps, heartbeats, "
+             "expositions, history tails)",
+    )
+    pm.add_argument(
+        "dirs", nargs="+",
+        help="directories to scan (--crash_dir / --heartbeat_dir / "
+             "--metrics_dir / wherever the run's files landed); first "
+             "dir receives the bundle by default",
+    )
+    pm.add_argument("--out", default=None, metavar="PATH",
+                    help="bundle output path (default <first dir>/"
+                         "postmortem.json)")
+    pm.add_argument(
+        "--annotate", action="store_true",
+        help="append a 'postmortem' record (history schema v9) to the "
+             "discovered rank-0 history JSONL so summarize/tail/pod "
+             "render the crash — the watchdog auto-invoke sets this",
+    )
+    pm.add_argument("--tail", type=int, default=40, metavar="N",
+                    help="ring records kept per rank in the bundle")
+    pm.add_argument("--format", choices=("text", "json"), default="text")
     args = ap.parse_args(argv)
+
+    if args.cmd == "postmortem":
+        from tpu_dist.obs import postmortem as postmortem_lib
+
+        missing = [d for d in args.dirs if not os.path.isdir(d)]
+        if missing:
+            print(
+                f"tpu_dist.obs: cannot read director"
+                f"{'y' if len(missing) == 1 else 'ies'} "
+                + ", ".join(missing), file=sys.stderr,
+            )
+            return 2
+        report, bundle = postmortem_lib.run_postmortem(
+            args.dirs, out=args.out, annotate=args.annotate, tail=args.tail,
+        )
+        if bundle is None:
+            print(
+                "tpu_dist.obs: no forensic artifacts (flight rings, "
+                "stack dumps, heartbeats, expositions, histories) found "
+                "in " + ", ".join(args.dirs), file=sys.stderr,
+            )
+            return 1
+        if args.format == "json":
+            print(json.dumps(report, indent=2, default=str))
+        else:
+            print(postmortem_lib.format_text(report))
+        print(f"bundle written to {bundle}")
+        return 0
 
     if args.cmd == "xprof":
         from tpu_dist.obs import xprof as xprof_lib

@@ -158,6 +158,8 @@ def pod_report(
             "world_sizes": rep.get("world_sizes", []),
             # fleet-scheduler chip moves (schema v8) found in this log
             "fleet_decisions": rep.get("fleet_decisions", []),
+            # crash bundles (schema v9): how this host's run DIED
+            "postmortems": rep.get("postmortems", []),
         })
     fracs = [
         h["goodput"]["goodput_frac"] for h in hosts
@@ -264,6 +266,20 @@ def format_text(report: dict) -> str:
                 + goodput_lib.fleet_move_phrase(fd)
                 + (f" — {fd['reason']}" if fd.get("reason") else "")
             )
+    # crash forensics (schema v9): a postmortem bundle in a host's log
+    # means that run DIED hard — the pod view must lead with who crashed
+    # and where it was stuck, not bury it under throughput rows
+    for h in report["hosts"]:
+        for pm in h.get("postmortems", []):
+            from tpu_dist.obs.postmortem import rank_summary, sorted_ranks
+
+            lines.append(
+                f"POSTMORTEM on {h['host']}: crash bundle over "
+                f"{pm.get('n_ranks')} rank(s)"
+                + (f" — {pm['bundle']}" if pm.get("bundle") else "")
+            )
+            for rank in sorted_ranks(pm.get("verdicts") or {}):
+                lines.append(f"  rank {rank}: {rank_summary(pm, rank)}")
     # per-host profiler captures: paths + the xprof analysis rollup, so
     # the pod view answers WHERE each capture lives and WHAT it said —
     # not just who heartbeats and who straggles

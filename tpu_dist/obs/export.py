@@ -281,6 +281,29 @@ def active_labels(
     return sorted(out)
 
 
+#: The "why was this worker sick" gauge set — ONE list shared by the
+#: launcher watchdog's wedge report and the postmortem assembler, so the
+#: two reads can never drift: (raw registry name, short label, format).
+KEY_GAUGES = (
+    ("train.epoch", "epoch", "g"),
+    ("train.data_stall_frac", "stall", ".1%"),
+    ("train.mfu", "mfu", ".3f"),
+    ("goodput.goodput_frac", "goodput", ".1%"),
+    ("compile.retraces", "retraces", "g"),
+)
+
+
+def key_gauges(vals: Dict[str, float]) -> Dict[str, str]:
+    """The :data:`KEY_GAUGES` subset of a scraped exposition, formatted:
+    ``{"epoch": "2", "stall": "41.0%", ...}`` — absent gauges omitted."""
+    out: Dict[str, str] = {}
+    for raw, label, spec in KEY_GAUGES:
+        v = vals.get(metric_name(raw))
+        if v is not None:
+            out[label] = format(v, spec)
+    return out
+
+
 def scrape(
     *, textfile: Optional[str] = None, port: Optional[int] = None,
     host: str = "127.0.0.1", timeout: float = 2.0,

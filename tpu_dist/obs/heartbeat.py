@@ -113,6 +113,40 @@ def per_rank_path(base: str, rank: int) -> str:
     return base if rank == 0 else f"{base}.h{rank}"
 
 
+def sweep_stale_ranks(base: str, world: int) -> int:
+    """Remove per-rank derived files (``base.h<k>``, plus their
+    ``.tmp``) for ranks OUTSIDE the current world (``k >= world``).
+
+    The elastic-resize hole this closes (docs/resilience.md "Elastic
+    training"): after a shrink (8→4), ranks 4-7's heartbeat/metrics
+    files from the departed world linger on disk — the watchdog would
+    read their frozen counters and ``obs pod`` would row them as dead
+    workers, when they are simply no longer part of the run. The
+    launcher calls this for every injected base path before spawning a
+    round. Returns the number of files removed; best-effort (a racing
+    unlink is fine — the file being gone IS the goal)."""
+    d = os.path.dirname(os.path.abspath(base))
+    name = os.path.basename(base)
+    prefix = name + ".h"
+    removed = 0
+    try:
+        entries = os.listdir(d)
+    except OSError:
+        return 0
+    for entry in entries:
+        if not entry.startswith(prefix):
+            continue
+        suffix = entry[len(prefix):]
+        core = suffix[:-4] if suffix.endswith(".tmp") else suffix
+        if core.isdigit() and int(core) >= world:
+            try:
+                os.remove(os.path.join(d, entry))
+                removed += 1
+            except OSError:  # tpu-dist: ignore[TD006] — racing unlink:
+                pass  # the file being gone is exactly the goal
+    return removed
+
+
 # last successfully parsed beat per path: the torn-read fallback below.
 # Process-local by design — each watchdog process keeps its own view.
 _LAST_GOOD: dict = {}

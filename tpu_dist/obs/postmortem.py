@@ -1,0 +1,470 @@
+"""``obs postmortem`` — assemble per-rank crash-forensics bundles
+(``docs/observability.md`` "Crash forensics").
+
+After a wedge/kill, the evidence is scattered across per-rank files that
+different subsystems left behind: the SIGKILL-surviving flight ring and
+the faulthandler stack dumps (``--crash_dir``, ``obs/flight.py``), the
+heartbeat left un-swept (``--heartbeat_file``), the last OpenMetrics
+exposition (``--metrics_file``), and the history JSONL's tail
+(``--log_file``). This module walks a set of directories, groups the
+artifacts by rank (the shared ``.h<k>`` naming — ``heartbeat.
+per_rank_path``), and folds them into ONE report per rank:
+
+* the decoded ring tail (ordered records, torn-slot count, the last
+  ``step`` slot — where the rank was when it stopped writing),
+* the parsed stack dump (all threads, the stuck frame by name),
+* the last heartbeat (position + phase),
+* the last exposition's key gauges + active alerts,
+* a verdict: ``clean`` / ``preempted`` / ``interrupted`` / ``fatal`` /
+  ``no-clean-exit`` (the hard-kill/wedge signature: a ring that simply
+  stops).
+
+The launcher watchdog auto-invokes this after killing a wedged worker
+(``cli/launch.py``), appending one ``postmortem`` record (history schema
+v9) to the run's JSONL so ``obs summarize`` / ``tail`` / ``pod`` render
+the crash next to the telemetry that led up to it.
+
+Pure host-side file crunching — no jax, runs anywhere the files can be
+copied to. CLI in ``obs/__main__.py``::
+
+    python -m tpu_dist.obs postmortem <dir> [<dir> ...] [--out bundle.json]
+        [--annotate] [--tail N] [--format text|json]
+
+Exit codes: 0 bundle assembled, 1 no forensic artifacts found in the
+given dirs, 2 unreadable input.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tpu_dist.obs import export as export_lib
+from tpu_dist.obs import flight as flight_lib
+from tpu_dist.obs import heartbeat as heartbeat_lib
+from tpu_dist.obs import summarize as summ
+
+#: Default bundle file name (written into the first scanned dir).
+BUNDLE_NAME = "postmortem.json"
+
+#: ``postmortem`` records stamp the CURRENT history schema (metrics/
+#: history.py — v9 introduced this kind). Kept as a literal so this
+#: module stays jax-free (the watchdog's auto-invoke and any laptop
+#: holding the copied files must not need a backend); pinned to the
+#: real SCHEMA_VERSION by ``tests/test_flight.py`` — the fleet-module
+#: discipline (``FLEET_SCHEMA_VERSION``).
+POSTMORTEM_SCHEMA_VERSION = 9
+
+#: Artifact stems recognized during discovery; each may carry the
+#: ``.h<k>`` per-rank suffix. History files are any ``*.jsonl``.
+_HB_STEM = "hb.json"
+_METRICS_STEM = "metrics.prom"
+
+_RANK_SUFFIX_RE = re.compile(r"^(?P<stem>.+?)\.h(?P<rank>\d+)$")
+
+
+def _split_rank(name: str) -> Tuple[str, int]:
+    m = _RANK_SUFFIX_RE.match(name)
+    if m:
+        return m.group("stem"), int(m.group("rank"))
+    return name, 0
+
+
+def discover(dirs: List[str]) -> dict:
+    """Walk the given dirs (non-recursive) and group forensic artifacts
+    by rank: ``{"rings": {rank: path}, "stacks": {...}, "heartbeats":
+    {...}, "expositions": {...}, "histories": {rank: path}, "scanned":
+    [dirs that existed]}``. First occurrence of a (kind, rank) wins —
+    pass the most authoritative dir first."""
+    rings: Dict[int, str] = {}
+    stacks: Dict[int, str] = {}
+    hbs: Dict[int, str] = {}
+    expos: Dict[int, str] = {}
+    hists: Dict[int, str] = {}
+    scanned: List[str] = []
+    for d in dirs:
+        try:
+            entries = sorted(os.listdir(d))
+        except OSError:
+            continue
+        scanned.append(d)
+        for entry in entries:
+            stem, rank = _split_rank(entry)
+            path = os.path.join(d, entry)
+            if stem == flight_lib.RING_NAME:
+                rings.setdefault(rank, path)
+            elif stem == flight_lib.STACKS_NAME:
+                stacks.setdefault(rank, path)
+            elif stem == _HB_STEM or (
+                stem.endswith(".json") and "hb" in stem.split(".")[0]
+            ):
+                hbs.setdefault(rank, path)
+            elif stem == _METRICS_STEM or stem.endswith(".prom"):
+                expos.setdefault(rank, path)
+            elif stem.endswith(".jsonl"):
+                hists.setdefault(rank, path)
+    return {
+        "rings": rings, "stacks": stacks, "heartbeats": hbs,
+        "expositions": expos, "histories": hists, "scanned": scanned,
+    }
+
+
+def _ring_section(path: str, tail: int) -> Optional[dict]:
+    try:
+        dec = flight_lib.decode(path)
+    except OSError:
+        return {"file": path, "error": "unreadable"}
+    last = flight_lib.last_step(dec)
+    fatals = flight_lib.fatal_records(dec)
+    recs = dec["records"]
+    return {
+        "file": path,
+        "header": dec.get("header"),
+        "n_records": len(recs),
+        "torn_slots": dec["torn_slots"],
+        "records": recs[-tail:],
+        "last": dec.get("last"),
+        "last_step": last,
+        "fatal": fatals[-1] if fatals else None,
+    }
+
+
+def _stack_section(path: str) -> Optional[dict]:
+    parsed = flight_lib.read_stack_dump(path)
+    if parsed is None:
+        return None
+    return {
+        "file": path,
+        "n_dumps": parsed["n_dumps"],
+        "n_threads": len(parsed["threads"]),
+        "threads": [
+            {
+                "name": t.get("name"),
+                "current": t["current"],
+                "top": (
+                    f"{t['frames'][0][2]} "
+                    f"({t['frames'][0][0]}:{t['frames'][0][1]})"
+                    if t["frames"] else None
+                ),
+            }
+            for t in parsed["threads"]
+        ],
+        "stuck_frame": flight_lib.stuck_frame(parsed),
+    }
+
+
+def _exposition_section(path: str) -> Optional[dict]:
+    vals = export_lib.scrape(textfile=path)
+    if not vals:
+        return None
+    out = {"file": path, "gauges": export_lib.key_gauges(vals)}
+    active = export_lib.active_labels(vals)
+    if active:
+        out["active_alerts"] = active
+    return out
+
+
+def _verdict(ring: Optional[dict], stack: Optional[dict],
+             heartbeat: Optional[dict]) -> str:
+    """Classify how the rank ended. A ring whose terminal record is
+    ``exit``/``preempt``/``interrupt`` ended on its own terms; one that
+    just stops (plus a left-behind heartbeat) is the wedge/hard-kill
+    signature ``obs postmortem`` exists for."""
+    if ring and ring.get("fatal"):
+        return "fatal"
+    last = (ring or {}).get("last") or {}
+    kind = last.get("kind")
+    if kind == "exit":
+        return "clean" if last.get("clean") else "failed"
+    if kind == "preempt":
+        return "preempted"
+    if kind == "interrupt":
+        return "interrupted"
+    if ring and ring.get("n_records"):
+        return "no-clean-exit"
+    if heartbeat is not None:
+        return "no-clean-exit"
+    return "unknown"
+
+
+def assemble(
+    dirs: List[str], *, tail: int = 40, history_tail: int = 20,
+) -> dict:
+    """The bundle: one per-rank report over everything :func:`discover`
+    found, plus the shared history tail. Tolerates every per-artifact
+    failure (a half-written file is the expected input here)."""
+    found = discover(dirs)
+    ranks = sorted(
+        set(found["rings"]) | set(found["stacks"]) | set(found["heartbeats"])
+        | set(found["expositions"])
+    )
+    rank_reports: List[dict] = []
+    for rank in ranks:
+        ring = (
+            _ring_section(found["rings"][rank], tail)
+            if rank in found["rings"] else None
+        )
+        stack = (
+            _stack_section(found["stacks"][rank])
+            if rank in found["stacks"] else None
+        )
+        hb = (
+            heartbeat_lib.read(found["heartbeats"][rank])
+            if rank in found["heartbeats"] else None
+        )
+        expo = (
+            _exposition_section(found["expositions"][rank])
+            if rank in found["expositions"] else None
+        )
+        rank_reports.append({
+            "rank": rank,
+            "verdict": _verdict(ring, stack, hb),
+            "flight": ring,
+            "stack": stack,
+            "heartbeat": hb,
+            "exposition": expo,
+        })
+    histories = []
+    for rank in sorted(found["histories"]):
+        path = found["histories"][rank]
+        try:
+            records, bad = summ.load_records(path)
+        except OSError:
+            histories.append({"rank": rank, "file": path,
+                              "error": "unreadable"})
+            continue
+        histories.append({
+            "rank": rank,
+            "file": path,
+            "n_records": len(records),
+            "bad_lines": bad,
+            "run_id": next(
+                (r["run_id"] for r in reversed(records) if r.get("run_id")),
+                None,
+            ),
+            "tail": records[-history_tail:],
+        })
+    return {
+        "generated_ts": round(time.time(), 3),
+        "scanned_dirs": found["scanned"],
+        "n_ranks": len(rank_reports),
+        "ranks": rank_reports,
+        "histories": histories,
+    }
+
+
+def write_bundle(report: dict, out_path: str) -> str:
+    # tpu-dist: ignore[TD002] — postmortem tooling runs in the single
+    # watchdog/CLI process, never inside a multi-process training job
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+    return out_path
+
+
+def history_record(report: dict, bundle_path: Optional[str]) -> dict:
+    """The compact ``postmortem`` history record (schema v9): enough for
+    ``obs summarize``/``tail``/``pod`` to render the crash without
+    re-reading the bundle."""
+    verdicts = {str(r["rank"]): r["verdict"] for r in report["ranks"]}
+    stuck = {
+        str(r["rank"]): r["stack"]["stuck_frame"]
+        for r in report["ranks"]
+        if r.get("stack") and r["stack"].get("stuck_frame")
+    }
+    fatal = {
+        str(r["rank"]): (
+            f"{r['flight']['fatal'].get('error')}: "
+            f"{r['flight']['fatal'].get('message')}"
+        )
+        for r in report["ranks"]
+        if r.get("flight") and r["flight"].get("fatal")
+    }
+    last_steps = {
+        str(r["rank"]): {
+            k: r["flight"]["last_step"].get(k) for k in ("epoch", "step")
+        }
+        for r in report["ranks"]
+        if r.get("flight") and r["flight"].get("last_step")
+    }
+    rec = {
+        "n_ranks": report["n_ranks"],
+        "verdicts": verdicts,
+    }
+    if bundle_path:
+        rec["bundle"] = bundle_path
+    if stuck:
+        rec["stuck_frames"] = stuck
+    if fatal:
+        rec["fatal"] = fatal
+    if last_steps:
+        rec["last_steps"] = last_steps
+    return rec
+
+
+def sorted_ranks(mapping: dict) -> List[str]:
+    """Rank keys of a ``postmortem`` record's per-rank dicts, NUMERICALLY
+    ordered (they are JSON string keys — a lexicographic sort would print
+    0,1,10,11,...,2 on a 16-rank pod). ONE home for the ordering every
+    renderer (summarize/tail/pod) shares."""
+    return sorted(
+        mapping,
+        key=lambda r: (
+            not str(r).isdigit(),
+            int(r) if str(r).isdigit() else 0,
+            str(r),
+        ),
+    )
+
+
+def rank_summary(rec: dict, rank: str) -> str:
+    """One line for one rank of a ``postmortem`` history record —
+    ``'fatal, stuck in get (loader.py:118), flight ring ends at epoch 2
+    step 3'``. ONE formatter shared by ``obs summarize``/``tail``/``pod``
+    so the three renderings can never drift."""
+    verdict = (rec.get("verdicts") or {}).get(rank, "unknown")
+    stuck = (rec.get("stuck_frames") or {}).get(rank)
+    fatal = (rec.get("fatal") or {}).get(rank)
+    ls = (rec.get("last_steps") or {}).get(rank) or {}
+    return (
+        str(verdict)
+        + (f", stuck in {stuck}" if stuck else "")
+        + (f", fatal {fatal}" if fatal else "")
+        + (
+            f", flight ring ends at epoch {ls.get('epoch')} step "
+            f"{ls.get('step')}" if ls else ""
+        )
+    )
+
+
+def append_history_record(report: dict, bundle_path: Optional[str],
+                          history_path: str) -> dict:
+    """Append the ``postmortem`` record to the run's JSONL in the
+    MetricsHistory line format (the watchdog's auto-invoke path — the
+    crash lands in the same log the run was writing, where ``obs tail``
+    picks it up as it lands)."""
+    rec = {
+        "ts": round(time.time(), 3),
+        "schema_version": POSTMORTEM_SCHEMA_VERSION,
+        "kind": "postmortem",
+        **history_record(report, bundle_path),
+    }
+    # tpu-dist: ignore[TD002] — single watchdog/CLI process (see above)
+    with open(history_path, "a") as f:
+        f.write(json.dumps(rec, default=str) + "\n")
+    return rec
+
+
+def run_postmortem(
+    dirs: List[str], *, out: Optional[str] = None, annotate: bool = False,
+    tail: int = 40,
+) -> Tuple[dict, Optional[str]]:
+    """The whole auto-invoke path (watchdog + CLI): assemble, write the
+    bundle next to the evidence, optionally annotate the discovered
+    primary history. Returns ``(report, bundle_path)``; ``bundle_path``
+    is None when nothing at all was found (no bundle worth writing)."""
+    report = assemble(dirs, tail=tail)
+    if not report["ranks"] and not report["histories"]:
+        return report, None
+    bundle = out or os.path.join(
+        (report["scanned_dirs"] or dirs)[0], BUNDLE_NAME
+    )
+    write_bundle(report, bundle)
+    if annotate:
+        primary = next(
+            (h["file"] for h in report["histories"]
+             if h.get("rank") == 0 and not h.get("error")),
+            None,
+        )
+        if primary:
+            append_history_record(report, bundle, primary)
+    return report, bundle
+
+
+def format_text(report: dict) -> str:
+    lines = [
+        f"postmortem — {report['n_ranks']} rank(s) across "
+        f"{len(report.get('scanned_dirs') or [])} dir(s)"
+    ]
+    for r in report["ranks"]:
+        lines.append(f"rank {r['rank']}: {r['verdict'].upper()}")
+        ring = r.get("flight")
+        if ring:
+            if ring.get("error"):
+                lines.append(f"  flight ring: {ring['error']} ({ring['file']})")
+            else:
+                ls = ring.get("last_step")
+                lines.append(
+                    f"  flight ring: {ring['n_records']} record(s)"
+                    + (
+                        f", {ring['torn_slots']} torn slot(s)"
+                        if ring.get("torn_slots") else ""
+                    )
+                    + (
+                        f" — last step epoch {ls.get('epoch')} step "
+                        f"{ls.get('step')}" if ls else " — no step record"
+                    )
+                )
+                fatal = ring.get("fatal")
+                if fatal:
+                    lines.append(
+                        f"  fatal: {fatal.get('error')}: "
+                        f"{fatal.get('message')}"
+                    )
+                    for fr in fatal.get("frames") or []:
+                        lines.append(f"    {fr}")
+                last = ring.get("last") or {}
+                if last.get("kind") in ("exit", "preempt", "interrupt"):
+                    lines.append(f"  terminal record: {last['kind']}")
+        stack = r.get("stack")
+        if stack:
+            lines.append(
+                f"  stack dump: {stack['n_threads']} thread(s), "
+                f"{stack['n_dumps']} dump(s)"
+                + (
+                    f" — stuck in {stack['stuck_frame']}"
+                    if stack.get("stuck_frame") else ""
+                )
+            )
+            for t in stack["threads"]:
+                if not t["current"] and t.get("top"):
+                    lines.append(
+                        f"    thread {t.get('name') or '?'}: {t['top']}"
+                    )
+        hb = r.get("heartbeat")
+        if hb:
+            lines.append(
+                f"  heartbeat left behind: beat {hb.get('counter')} at "
+                f"epoch {hb.get('epoch')} step {hb.get('step')} phase "
+                f"{hb.get('phase')!r}"
+            )
+        expo = r.get("exposition")
+        if expo:
+            gauges = ", ".join(
+                f"{k} {v}" for k, v in (expo.get("gauges") or {}).items()
+            )
+            lines.append(f"  last exposition: {gauges or '(empty)'}")
+            if expo.get("active_alerts"):
+                lines.append(
+                    "  active alerts: " + ", ".join(expo["active_alerts"])
+                )
+    for h in report.get("histories", []):
+        if h.get("error"):
+            lines.append(f"history {h['file']}: {h['error']}")
+            continue
+        lines.append(
+            f"history {h['file']}: {h['n_records']} record(s)"
+            + (f", {h['bad_lines']} torn line(s)" if h.get("bad_lines") else "")
+            + (f", run {h['run_id']}" if h.get("run_id") else "")
+        )
+        for rec in (h.get("tail") or [])[-5:]:
+            lines.append(
+                f"  [{rec.get('rel_s')}] {rec.get('kind')}"
+                + (
+                    f" epoch {rec.get('epoch')}"
+                    if rec.get("epoch") is not None else ""
+                )
+            )
+    return "\n".join(lines)

@@ -49,6 +49,12 @@ _PID = 0
 # origin keeps cross-thread spans comparable in the viewer.
 _T0 = time.perf_counter()
 _ANNOTATION = None  # cached jax.profiler.TraceAnnotation (resolved lazily)
+# Span-OPEN listener (the flight recorder's tap, docs/observability.md
+# "Crash forensics"): called with (name, args) the moment a span opens,
+# INDEPENDENT of the recorder being enabled — crash forensics runs on
+# every rank, while span buffering stays rank-0-only. The listener must
+# never raise (FlightRecorder.record is never-raise by contract).
+_OPEN_LISTENER = None
 
 
 class _NullSpan:
@@ -75,8 +81,11 @@ class _Span:
         self._ann = None
 
     def __enter__(self):
+        lis = _OPEN_LISTENER
+        if lis is not None:
+            lis(self.name, self.args)
         ann = _ANNOTATION
-        if ann is not None:
+        if ann is not None and _ENABLED:
             # bridge: while this host span is open, the XLA profiler (when
             # capturing) tags device activity with the same name
             self._ann = ann(self.name)
@@ -93,10 +102,26 @@ class _Span:
 
 
 def span(name: str, **args):
-    """Context manager timing a host region. Free when disabled."""
-    if not _ENABLED:
+    """Context manager timing a host region. Free when disabled (a real
+    span is still constructed — without buffering — when only the crash-
+    forensics open listener is set, so span opens reach the flight ring
+    on every rank)."""
+    if not _ENABLED and _OPEN_LISTENER is None:
         return _NULL
     return _Span(name, args)
+
+
+def set_open_listener(fn) -> None:
+    """Arm the span-open tap (one per process; the trainer points it at
+    its :class:`~tpu_dist.obs.flight.FlightRecorder`). ``fn(name, args)``
+    is called at every span open, enabled or not."""
+    global _OPEN_LISTENER
+    _OPEN_LISTENER = fn
+
+
+def clear_open_listener() -> None:
+    global _OPEN_LISTENER
+    _OPEN_LISTENER = None
 
 
 def add_event(name: str, t_start: float, duration: float, **args) -> None:

@@ -29,14 +29,14 @@ from tpu_dist.obs import goodput as goodput_lib
 #: kinds summarized; their unknown kinds are skipped with a count — the
 #: forward-compat contract that lets v3 tooling read v4 logs and vice
 #: versa (every schema bump is additive).
-SUPPORTED_SCHEMA = 8
+SUPPORTED_SCHEMA = 9
 
 #: Record kinds this reader folds into the report. Anything else is
 #: counted into ``skipped_kinds`` — never an error, never silent.
 KNOWN_KINDS = frozenset((
     "train_epoch", "eval", "straggler", "anomaly", "device_stats",
     "auto_recover", "spans", "goodput", "profile", "alert",
-    "profile_analysis", "resume", "fleet",
+    "profile_analysis", "resume", "fleet", "postmortem",
 ))
 
 
@@ -76,6 +76,7 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
     resumes: List[dict] = []  # segment boundaries (world size, reshard)
     world_sizes: List[int] = []  # distinct dp extents, in order of appearance
     fleet_decisions: List[dict] = []  # scheduler chip moves (schema v8)
+    postmortems: List[dict] = []  # crash bundles (schema v9)
     dstats: dict = {}  # epoch -> per-epoch device_stats aggregate
     recoveries = 0
     prev_counters: Optional[dict] = None
@@ -172,6 +173,16 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
                           "pending_after", "reason", "inputs")
                 if rec.get(k) is not None
             })
+        elif kind == "postmortem":
+            # a crash bundle (schema v9): the watchdog/CLI assembler's
+            # after-the-fact record of how the run DIED — verdicts per
+            # rank, stuck frames, where each flight ring stopped
+            postmortems.append({
+                k: rec.get(k)
+                for k in ("bundle", "n_ranks", "verdicts", "stuck_frames",
+                          "fatal", "last_steps")
+                if rec.get(k) is not None
+            })
         elif kind == "profile":
             profiles.append({
                 k: rec.get(k)
@@ -261,6 +272,7 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
         "resumes": resumes,
         "world_sizes": world_sizes,
         "fleet_decisions": fleet_decisions,
+        "postmortems": postmortems,
         "stragglers": stragglers,
         "anomalies": anomalies,
         "alerts": alerts,
@@ -399,6 +411,17 @@ def format_text(report: dict) -> str:
             f"{_fmt(ds.get('update_ratio_last'), '.3g', 0).strip()} "
             f"({ds.get('samples')} sample(s))"
         )
+    for pm in report.get("postmortems", []):
+        # per-rank lines through the ONE shared formatter (obs/
+        # postmortem.py — jax-free): summarize/tail/pod can never drift
+        from tpu_dist.obs.postmortem import rank_summary, sorted_ranks
+
+        lines.append(
+            f"POSTMORTEM: crash bundle over {pm.get('n_ranks')} rank(s)"
+            + (f" — {pm['bundle']}" if pm.get("bundle") else "")
+        )
+        for rank in sorted_ranks(pm.get("verdicts") or {}):
+            lines.append(f"  rank {rank}: {rank_summary(pm, rank)}")
     for a in report.get("alerts", []):
         lines.append(
             f"alert: {a.get('rule')} fired at epoch {a.get('epoch')}"

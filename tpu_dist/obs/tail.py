@@ -98,6 +98,8 @@ class TailState:
         self.events: List[str] = []
         self.alerts_fired = 0
         self.finished = False  # run-end goodput totals record seen
+        self.crashed = False   # postmortem crash bundle seen (schema v9)
+        self.bundle: Optional[str] = None  # the bundle's path, when known
 
     def add(self, records: List[dict]) -> None:
         for rec in records:
@@ -223,6 +225,37 @@ class TailState:
                         if rec.get("reason") else ""
                     )
                 )
+            elif kind == "postmortem":
+                # a crash bundle landed (schema v9, the watchdog's
+                # auto-invoke): the run did NOT end cleanly — render the
+                # per-rank fatal/wedge findings and stop following (no
+                # goodput-final record is coming from a dead writer)
+                self.crashed = True
+                self.finished = True
+                self.bundle = rec.get("bundle") or self.bundle
+                self._event(
+                    f"POSTMORTEM: crash bundle over {rec.get('n_ranks')} "
+                    "rank(s)"
+                    + (f" — {rec['bundle']}" if rec.get("bundle") else "")
+                )
+                from tpu_dist.obs.postmortem import sorted_ranks
+
+                verdicts = rec.get("verdicts") or {}
+                stuck = rec.get("stuck_frames") or {}
+                fatal = rec.get("fatal") or {}
+                for rank in sorted_ranks(verdicts):
+                    if rank in fatal:
+                        self._event(
+                            f"fatal on rank {rank}: {fatal[rank]}"
+                        )
+                    elif rank in stuck:
+                        self._event(
+                            f"rank {rank} wedged — stuck in {stuck[rank]}"
+                        )
+                    elif verdicts[rank] not in ("clean", "preempted"):
+                        self._event(
+                            f"rank {rank}: {verdicts[rank]}"
+                        )
 
     def _event(self, line: str) -> None:
         self.events.append(line)
@@ -279,8 +312,17 @@ class TailState:
                 + (f", age {age:.1f}s" if age is not None else "")
                 + (" — STALE" if stale else "")
             )
-        elif self.finished:
+        elif self.finished and not self.crashed:
             lines.append("heartbeat: swept (clean exit)")
+        if self.finished:
+            # the exit line says HOW it ended: a clean run swept its
+            # heartbeat and wrote its goodput totals; a crashed one left
+            # a postmortem bundle behind instead
+            lines.append(
+                "run: CRASHED — postmortem bundle left behind"
+                + (f" ({self.bundle})" if self.bundle else "")
+                if self.crashed else "run: clean exit"
+            )
         return "\n".join(lines)
 
 

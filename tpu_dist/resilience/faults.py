@@ -37,6 +37,16 @@ run after run (the property the bit-identical chaos tests in
     (it exits without its end-of-epoch sentinel, exactly like a thread
     torn down at interpreter shutdown) — the consumer watchdog must turn
     this into a clear error instead of hanging the epoch.
+``hang@step=S[:epoch=E][:rank=R][:seconds=T]``
+    WEDGE this process at step S: the hook spins in a sleep loop (no
+    exception, no exit code, heartbeat frozen — the live-but-silent
+    failure no cooperative shutdown can see). The launcher watchdog's
+    whole forensic chain exists for this site: frozen-beat detection,
+    SIGUSR1 stack dump (which names this very loop), SIGTERM→SIGKILL
+    escalation, postmortem bundle (docs/observability.md "Crash
+    forensics"). ``seconds`` bounds the hang (0 = forever, the default);
+    SIGTERM does NOT unwedge it — the cooperative flag is checked at
+    step boundaries this process will never reach again.
 
 Each clause fires ``times`` times (default 1) and then disarms. Injection
 points call the ``on_*`` hooks below; with no plan installed every hook is
@@ -66,10 +76,17 @@ ENV_VAR = "TPU_DIST_FAULT_PLAN"
 NAN_LOSS = "nan_loss"
 SIGTERM = "sigterm"
 RANK_KILL = "rank_kill"
+HANG = "hang"
 
 SITES = (
     "ckpt_write", "ckpt_corrupt", "nan_loss", "sigterm", "loader_stall",
-    "rank_kill",
+    "rank_kill", "hang",
+)
+
+#: Sites that act at the step/batch grain — refused with --fused_epoch
+#: (the whole epoch is one jit call; they would silently never fire).
+STEPWISE_SITES = frozenset(
+    ("nan_loss", "sigterm", "loader_stall", "rank_kill", "hang")
 )
 
 _CKPT_NAME_RE = re.compile(r"ckpt_(\d+)\.(?:npz|manifest\.json)$")
@@ -82,6 +99,7 @@ _ALLOWED_KEYS = {
     "sigterm": {"step", "epoch", "times"},
     "loader_stall": {"batch", "epoch", "times"},
     "rank_kill": {"step", "rank", "epoch", "times"},
+    "hang": {"step", "epoch", "rank", "seconds", "times"},
 }
 _REQUIRED_KEYS = {
     "ckpt_write": {"call"},
@@ -90,6 +108,7 @@ _REQUIRED_KEYS = {
     "sigterm": {"step"},
     "loader_stall": {"batch"},
     "rank_kill": {"step", "rank"},
+    "hang": {"step"},
 }
 
 
@@ -117,7 +136,7 @@ class FaultClause:
         if not self.armed():
             return False
         for key, want in self.params.items():
-            if key in ("times", "mode", "seed", "frac", "errno"):
+            if key in ("times", "mode", "seed", "frac", "errno", "seconds"):
                 continue
             if key in coords and coords[key] != want:
                 return False
@@ -172,7 +191,7 @@ class FaultPlan:
                             f"fault clause {raw!r}: {key} must be an "
                             f"integer, got {val!r}"
                         ) from e
-                elif key == "frac":
+                elif key in ("frac", "seconds"):
                     params[key] = float(val)
                 else:
                     params[key] = val.strip()
@@ -324,7 +343,28 @@ def on_step(epoch: int, step: int, rank: Optional[int] = None) -> FrozenSet[str]
         # hard death by design: no handler, no emergency save, no exit
         # code discipline — the process is simply gone mid-run
         os.kill(os.getpid(), signal.SIGKILL)
+    for c in plan._matching("hang", epoch=epoch, step=step, rank=rank):
+        c.fired += 1
+        _record_fired("hang")
+        actions.add(HANG)
+        # live-but-silent wedge by design: no exception, no signal, the
+        # heartbeat counter simply stops advancing — only an EXTERNAL
+        # watchdog (SIGUSR1 dump names this loop, then SIGKILL) ends it
+        _hang(float(c.params.get("seconds", 0)))
     return frozenset(actions)
+
+
+def _hang(seconds: float = 0) -> None:
+    """Spin in a sleep loop — deterministic stand-in for a deadlocked
+    collective / stuck I/O. ``seconds <= 0`` hangs forever (the drill
+    case: the watchdog's SIGKILL is the only way out); a bound makes the
+    site usable in in-process tests. SIGUSR1 interrupts a sleep, the
+    faulthandler dump runs, and the loop resumes — exactly a real wedge."""
+    import time  # noqa: PLC0415 — keep the module import-light (jax-free)
+
+    deadline = time.monotonic() + seconds if seconds > 0 else None
+    while deadline is None or time.monotonic() < deadline:
+        time.sleep(0.25)
 
 
 def on_loader_batch(batch: int, epoch: Optional[int] = None) -> Optional[str]:

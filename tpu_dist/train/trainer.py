@@ -170,8 +170,7 @@ class Trainer:
         plan = faults.configure(cfg.fault_plan)
         if plan is not None and cfg.fused_epoch:
             stepwise = sorted(
-                {c.site for c in plan.clauses}
-                & {"nan_loss", "sigterm", "loader_stall", "rank_kill"}
+                {c.site for c in plan.clauses} & faults.STEPWISE_SITES
             )
             if stepwise:
                 raise ValueError(
@@ -862,6 +861,9 @@ class Trainer:
 
         self._async_ckpt = None  # created lazily by _ckpt_io()
         self._heartbeat = None  # created by fit() (rank 0, --heartbeat_file)
+        self._flight = None  # per-rank flight recorder, armed by fit()
+        #                      (--crash_dir; obs/flight.py)
+        self._fault_handle = None  # armed faulthandler (stack capture)
         self._exporter = None  # live OpenMetrics publisher, created by fit()
         self._alerts = None  # AlertEngine, created by fit() per run
         self._export_rollup = {}  # latest epoch/health scalars for export
@@ -1373,6 +1375,11 @@ class Trainer:
             timer.tick()
             if hb is not None:
                 hb.beat(epoch=epoch, step=step)
+            if self._flight is not None:
+                # step-boundary slot (one atomic pwrite + counter delta):
+                # the ring of a SIGKILLed rank ends exactly at the last
+                # completed step — readable after the hardest of kills
+                self._flight.step(epoch, step)
             if self._exporter is not None:
                 # live exposition at the SAME step-grain throttle as the
                 # heartbeat: inside the window only the in-memory HTTP
@@ -1541,6 +1548,9 @@ class Trainer:
         counters_lib.inc("train.epochs")
         if self._heartbeat is not None:
             self._heartbeat.beat(epoch=epoch, phase="fused_epoch", force=True)
+        if self._flight is not None:
+            # the fused path's only grain: one step slot per epoch call
+            self._flight.step(epoch, None)
         if cfg.nan_guard and not np.isfinite(m["loss"]):
             raise TrainingDivergedError(
                 f"non-finite loss {m['loss']} in fused epoch {epoch} (lr={lr}); "
@@ -1696,6 +1706,10 @@ class Trainer:
             )
             if history is not None:
                 history.log("anomaly", **f)
+            if self._flight is not None:
+                self._flight.record(
+                    "anomaly", anomaly=f["anomaly"], epoch=epoch, step=step,
+                )
             counters_lib.inc("anomaly.findings")
             if (
                 self._profiler is not None
@@ -1822,6 +1836,11 @@ class Trainer:
                 f"{a['op']} threshold {a['threshold']} (sustained "
                 f"{a['sustained']} window(s))"
             )
+            if self._flight is not None:
+                self._flight.record(
+                    "alert", rule=a["rule"], epoch=epoch,
+                    **({"step": step} if step is not None else {}),
+                )
             if self._history is not None:
                 extra = {"epoch": epoch}
                 if step is not None:
@@ -2246,6 +2265,47 @@ class Trainer:
         # through this handle; cleared in the finally below so a direct
         # train_epoch() call outside fit() never logs to a closed file
         self._history = history
+        # crash forensics (docs/observability.md "Crash forensics"): a
+        # per-rank SIGKILL-surviving flight ring + faulthandler stack
+        # capture, armed on EVERY process — unlike the rank-0 telemetry,
+        # forensics is per-rank by definition (any rank can wedge)
+        self._flight = None
+        self._fault_handle = None
+        if cfg.crash_dir:
+            from tpu_dist.obs import flight as flight_lib  # noqa: PLC0415
+            from tpu_dist.obs.heartbeat import per_rank_path  # noqa: PLC0415
+
+            import os as _fos  # noqa: PLC0415
+
+            rank = jax.process_index()
+            self._flight = flight_lib.FlightRecorder(
+                per_rank_path(
+                    _fos.path.join(cfg.crash_dir, flight_lib.RING_NAME), rank
+                ),
+                run_id=run_id, rank=rank,
+            )
+            # last-words discipline: an UNHANDLED exception anywhere (main
+            # thread or a worker like the loader producer) stamps a fatal
+            # slot before the interpreter dies; previous hooks still run
+            self._flight.install_excepthooks()
+            # every host span OPEN (ckpt write/restore, loader produce,
+            # eval) taps one slot — the ring shows which host operation
+            # was in flight at death, on every rank, buffering none
+            spans_lib.set_open_listener(self._flight.span_open)
+            self._flight.record(
+                "open", epoch=self.start_epoch,
+                world=mesh_lib.process_count(), dp=self.n_data,
+            )
+            # hard-fault tracebacks land in the per-rank crash file, and
+            # SIGUSR1 dumps all threads on demand — the launcher watchdog
+            # signals a live-but-frozen rank and reads back WHERE it is
+            # stuck before escalating SIGTERM→SIGKILL
+            self._fault_handle = flight_lib.arm_faulthandler(
+                per_rank_path(
+                    _fos.path.join(cfg.crash_dir, flight_lib.STACKS_NAME),
+                    rank,
+                )
+            )
         # elastic observability (docs/resilience.md "Elastic training"):
         # the current world size is a first-class gauge (segment
         # boundaries in summarize/tail/pod key off it) and a supervisor-
@@ -2267,6 +2327,14 @@ class Trainer:
             # size, reshard flag, re-entry position — the segment-boundary
             # line obs summarize/tail/pod render
             history.log("resume", restarts=_restarts, **self._elastic_resume)
+            if self._flight is not None:
+                self._flight.record(
+                    "resume",
+                    epoch=self._elastic_resume.get("epoch"),
+                    world=self._elastic_resume.get("world"),
+                    dp=self._elastic_resume.get("dp"),
+                    resharded=self._elastic_resume.get("resharded"),
+                )
             self._elastic_resume = None
         # re-arm host-span tracing (construction armed it before the
         # resume-path restore; a second fit() on this Trainer re-arms after
@@ -2392,6 +2460,10 @@ class Trainer:
                         "auto_recover", epoch=self._last_epoch,
                         lr_scale=self._lr_scale,
                     )
+                    if self._flight is not None:
+                        self._flight.record(
+                            "auto_recover", epoch=self._last_epoch,
+                        )
         except (KeyboardInterrupt, PreemptedError) as e:
             # Ctrl-C and SIGTERM share one snapshot discipline; the caller
             # (cli/train.py) maps PreemptedError to the distinct
@@ -2448,6 +2520,32 @@ class Trainer:
             self._history = None
             history.close()
             self._heartbeat = None
+            if self._flight is not None:
+                # LAST teardown step so the drain/save spans above still
+                # tapped the ring. Classify the exit: a propagating
+                # failure stamps its fatal slot HERE (the excepthooks are
+                # being unwound), preemption/interrupt stamp their own
+                # terminal kind, a clean return stamps `exit` — a ring
+                # that ends with none of these was a hard kill.
+                import sys as _sys  # noqa: PLC0415
+
+                spans_lib.clear_open_listener()
+                self._flight.uninstall_excepthooks()
+                et, ev, tb = _sys.exc_info()
+                if et is None:
+                    self._flight.close("exit", clean=True)
+                elif issubclass(et, PreemptedError):
+                    self._flight.close("preempt", epoch=self._last_epoch)
+                elif issubclass(et, KeyboardInterrupt):
+                    self._flight.close("interrupt", epoch=self._last_epoch)
+                else:
+                    self._flight.fatal(et, ev, tb)
+                    self._flight.close("exit", clean=False)
+                self._flight = None
+                from tpu_dist.obs import flight as flight_lib  # noqa: PLC0415
+
+                flight_lib.disarm_faulthandler(self._fault_handle)
+                self._fault_handle = None
 
     def _emergency_save(self) -> None:
         """Ctrl-C / SIGTERM snapshot discipline (one path for both: the
