@@ -1,6 +1,6 @@
 # Convenience targets; CI / the driver call the underlying commands directly.
 
-.PHONY: test quick bench csrc clean lint pod-report monitor profile-report elastic-drill fleet-drill postmortem-drill
+.PHONY: test quick bench csrc clean lint pod-report monitor profile-report elastic-drill fleet-drill postmortem-drill serve-drill serve-report
 
 csrc:
 	$(MAKE) -C tpu_dist/csrc
@@ -61,6 +61,23 @@ fleet-drill:
 #   make postmortem-drill [WORKDIR=/tmp/postmortem_drill]
 postmortem-drill:
 	python -m tpu_dist.obs.drill --workdir $(or $(WORKDIR),/tmp/postmortem_drill)
+
+# The serving proof, locally: deterministic request-trace replay through
+# the continuous-batching engine — checkpoint loaded through the elastic
+# Remapper, zero post-warmup retraces (CompileWatcher), histogram
+# sum==count invariants, and the `obs compare --slo` exit contract (an
+# injected latency regression exits 1, an improvement exits 0)
+# (docs/serving.md):
+#   make serve-drill [WORKDIR=/tmp/serve_drill]
+serve-drill:
+	python -m tpu_dist.serve drill --workdir $(or $(WORKDIR),/tmp/serve_drill)
+
+# Offline serving SLO report over a run's serve records:
+#   make serve-report LOG=serve.jsonl
+# (docs/serving.md — per-window requests/s, latency p50/p99 bounds,
+# availability, occupancy, fired SLO alerts)
+serve-report:
+	python -m tpu_dist.serve report $(LOG)
 
 # Follow a LIVE run from another terminal:
 #   make monitor LOG=run.jsonl [HB=hb.json]

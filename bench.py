@@ -687,6 +687,85 @@ def _guarded_backend_init(timeout_s: float, default_invocation: bool = False) ->
         os._exit(3)
 
 
+def run_serve(
+    cfg: BenchConfig, n_requests: int, *, max_batch: int = 8,
+    tiny: bool = False,
+) -> dict:
+    """Serving micro-bench (``--serve``): drive the continuous-batching
+    engine (``tpu_dist/serve``) with a bursty deterministic arrival
+    pattern on the REAL clock and report the serving axis of the bench
+    trajectory — ``requests_per_s`` (the headline ``value``),
+    ``latency_p50_ms``/``latency_p99_ms`` (histogram upper bounds) and
+    ``batch_occupancy`` — with the standard capture fingerprint, so a
+    stale re-emission of a serving number is auto-flagged exactly like
+    a training one. ``tiny`` swaps in a narrow ResNet for CPU-emulation
+    validation (the measurement shape is the config's model)."""
+    t0 = time.perf_counter()
+    from tpu_dist.nn import resnet18, resnet34, resnet50
+    from tpu_dist.obs import counters as counters_lib
+    from tpu_dist.serve.engine import ServingEngine
+
+    counters_lib.reset()
+    if tiny:
+        from tpu_dist.serve.drill import _drill_model
+
+        model, image, classes, name = _drill_model(), 16, 10, "tiny"
+    else:
+        models = {
+            "resnet18": resnet18, "resnet34": resnet34, "resnet50": resnet50,
+        }
+        if cfg.model not in models:
+            raise ValueError(
+                f"--serve benches the dense image models, got {cfg.model!r}"
+            )
+        model = models[cfg.model](num_classes=cfg.num_classes)
+        image, classes, name = cfg.image_size, cfg.num_classes, cfg.model
+    import jax
+
+    params, bn_state = model.init(jax.random.PRNGKey(0))
+    engine = ServingEngine(model, params, bn_state, max_batch=max_batch)
+    engine.warmup((image, image, 3))
+    rng = np.random.default_rng(0)
+    payloads = rng.standard_normal(
+        (min(n_requests, 64), image, image, 3)
+    ).astype(np.float32)
+    t_meas = time.perf_counter()
+    submitted = 0
+    done = 0
+    burst_idx = 0
+    while done < n_requests:
+        if submitted < n_requests:
+            # bursty arrivals: alternate 3- and 7-request bursts so the
+            # batcher genuinely exercises several buckets
+            burst = (3, 7)[burst_idx % 2]
+            burst_idx += 1
+            for _ in range(min(burst, n_requests - submitted)):
+                engine.submit(payloads[submitted % len(payloads)],
+                              id=submitted)
+                submitted += 1
+        done += len(engine.pump())
+    meas_s = max(time.perf_counter() - t_meas, 1e-9)
+    stats = engine.stats
+    total_s = time.perf_counter() - t0
+    return _stamped({
+        "metric": f"serve_{name}_throughput",
+        "value": round(done / meas_s, 1),
+        "unit": "requests/sec",
+        "requests_per_s": round(done / meas_s, 1),
+        "latency_p50_ms": round((stats.total.quantile_bound(0.5) or 0) * 1e3, 3),
+        "latency_p99_ms": round((stats.total.quantile_bound(0.99) or 0) * 1e3, 3),
+        "ttfb_p99_ms": round((stats.ttfb.quantile_bound(0.99) or 0) * 1e3, 3),
+        "batch_occupancy": round(stats.batch_occupancy() or 0.0, 4),
+        "requests": done,
+        "batches": stats.batches,
+        "max_batch": max_batch,
+        "image_size": image,
+        "num_classes": classes,
+        "retraces": counters_lib.get("compile.retraces"),
+        "goodput_frac": round(meas_s / total_s, 4),
+    })
+
+
 def main() -> None:
     import os
 
@@ -765,6 +844,23 @@ def main() -> None:
              "CPU emulation) alongside measured throughput",
     )
     p.add_argument(
+        "--serve", action="store_true",
+        help="serving micro-bench: drive the continuous-batching engine "
+             "(tpu_dist/serve) with bursty arrivals and emit "
+             "requests_per_s / latency_p50_ms / latency_p99_ms / "
+             "batch_occupancy as one fingerprinted bench record — the "
+             "serving axis of the bench trajectory",
+    )
+    p.add_argument("--serve_requests", type=int, default=256,
+                   help="requests driven through the engine (--serve)")
+    p.add_argument("--serve_max_batch", type=int, default=8,
+                   help="bucket-ladder top (--serve; power of two)")
+    p.add_argument(
+        "--serve_tiny", action="store_true",
+        help="narrow-ResNet serving bench for CPU-emulation validation "
+             "(the measurement shape is the config's model)",
+    )
+    p.add_argument(
         "--scaling", action="store_true",
         help="run the config on 1,2,4,...,N-device meshes and report "
              "scaling efficiency (BASELINE's 1→8→32 chip metric; limited "
@@ -801,9 +897,16 @@ def main() -> None:
             args.config == "resnet18_cifar100"
             and args.grad_compression == "none"
             and not (args.all or args.table or args.scaling or args.pp
-                     or args.attn or args.attn_all or args.profile_dir)
+                     or args.attn or args.attn_all or args.profile_dir
+                     or args.serve)
         ),
     )
+    if args.serve:
+        print(json.dumps(run_serve(
+            CONFIGS[args.config], args.serve_requests,
+            max_batch=args.serve_max_batch, tiny=args.serve_tiny,
+        )), flush=True)
+        return
     if args.attn or args.attn_all:
         lengths = (1024, 4096, 16384) if args.attn_all else (args.attn,)
         for s in lengths:

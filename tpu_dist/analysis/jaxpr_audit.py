@@ -1248,6 +1248,106 @@ def elastic_grow_noop_violations(mesh=None) -> list[Violation]:
     return out
 
 
+def serving_slo_noop_violations(mesh=None) -> list[Violation]:
+    """TD114: the serving observability cost contract, checked at the
+    program level (the TD105-TD113 armed-vs-off discipline applied to
+    ``tpu_dist/serve``) — trace the bare inference forward step (the
+    audit MLP's eval-mode apply on one batch bucket), then arm the FULL
+    serve telemetry/SLO kit exactly as the engine's pump loop does:
+    streaming latency histograms observing real per-phase samples,
+    queue/occupancy/availability gauges published into the registry, the
+    SLO alert engine driven into a FIRED state (a breached p99 ceiling
+    and a blown deadline), the OpenMetrics histogram exposition rendered
+    AND parsed back, and a span open around the re-trace — and trace
+    again. The two jaxprs must be byte-identical: serving SLOs are host
+    arithmetic on timestamps the pump already takes, and the moment
+    someone routes a latency probe or a 'helpful' sync through the
+    compiled step, this trips. The probe also asserts the kit actually
+    RAN (histograms hold samples, a rule fired, the exposition
+    round-trips the count) — a dead stats object would make the
+    comparison vacuous."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.obs import counters as counters_lib
+    from tpu_dist.obs import export as export_lib
+    from tpu_dist.obs import spans
+    from tpu_dist.serve import slo as slo_lib
+
+    model = _AuditMLP()
+    params, bn = model.init(jax.random.PRNGKey(0))
+    x = jax.ShapeDtypeStruct((8, 2, 2, 3), jnp.float32)
+
+    def forward(p, s, images):
+        logits, _ = model.apply(p, s, images, train=False)
+        return logits
+
+    base = str(jax.make_jaxpr(forward)(params, bn, x))
+
+    stats = slo_lib.ServeStats(deadline_s=0.05)
+    engine = slo_lib.make_slo_engine(slo_lib.load_slo_rules("default"))
+    fired: list = []
+    for _ in range(3):  # 3 windows: sustain=2 rules genuinely sustain
+        for _ in range(4):
+            stats.on_batch(3, 4)
+            # 600 ms total: breaches the 500 ms slo_p99_high ceiling AND
+            # the 50 ms probe deadline (availability 0 < 0.999)
+            stats.on_request_done(
+                0.6, 0.45, {p: 0.1 for p in slo_lib.PHASES}
+            )
+        stats.set_queue_depth(2)
+        window = stats.scalars(window_s=1.0, completed_in_window=4)
+        stats.publish(window)
+        fired.extend(engine.observe(window))
+    exposition = export_lib.render(
+        counters_lib.snapshot(),
+        {"alert_active": engine.active()},
+        histograms=stats.histogram_families(),
+    )
+    parsed = export_lib.parse(exposition)
+    count_key = export_lib.metric_name("serve.latency_seconds") + "_count"
+    with spans.span("td114/trace_probe"):
+        armed = str(jax.make_jaxpr(forward)(params, bn, x))
+
+    out: list[Violation] = []
+    ran = (
+        stats.total.count == 12
+        and not stats.check_invariants()
+        and fired
+        and parsed.get(count_key) == 12
+    )
+    if not ran:
+        out.append(
+            Violation(
+                "TD114",
+                "<jaxpr:serving_slo_noop>",
+                0,
+                "the TD114 probe armed the serve SLO kit but it did not "
+                "actually run (histograms empty, invariants broken, no "
+                "rule fired, or the exposition failed to round-trip) — "
+                "the armed-vs-off comparison would be vacuous "
+                "(tpu_dist/serve/slo.py contract)",
+                snippet="serve slo probe did not fire",
+            )
+        )
+    if base != armed:
+        out.append(
+            Violation(
+                "TD114",
+                "<jaxpr:serving_slo_noop>",
+                0,
+                "the traced serving forward step CHANGED when the serve "
+                "telemetry/SLO machinery was armed (latency histograms "
+                "observing, gauges published, SLO rules fired, histogram "
+                "exposition rendered, span open) — serving observability "
+                "must stay host-side arithmetic around the unmodified "
+                "compiled step (tpu_dist/serve contract, docs/serving.md)",
+                snippet="jaxpr(bare_inference) != jaxpr(slo_armed)",
+            )
+        )
+    return out
+
+
 def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     """Run every (or the named) registered case. Returns
     ``(report, violations)`` where report maps case → op counts.
@@ -1255,8 +1355,8 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     reference pairs the report contains; full (unfiltered) runs also check
     the TD105 fault-injection, TD106 telemetry, TD107 device-metrics,
     TD108 profiler-trigger, TD109 live-export/alerting, TD110
-    capture-auto-analyze, TD111 elastic-resume, TD112 elastic-grow, and
-    TD113 flight-recorder no-op invariants."""
+    capture-auto-analyze, TD111 elastic-resume, TD112 elastic-grow,
+    TD113 flight-recorder, and TD114 serving-SLO no-op invariants."""
     report: dict = {}
     violations: list[Violation] = []
     for name in names if names is not None else registered_cases():
@@ -1291,6 +1391,9 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
         violations.extend(vs)
         vs = flight_recorder_noop_violations(mesh)
         report["dp_flight_recorder_noop"] = {"identical": not vs}
+        violations.extend(vs)
+        vs = serving_slo_noop_violations(mesh)
+        report["serving_slo_noop"] = {"identical": not vs}
         violations.extend(vs)
     return report, violations
 

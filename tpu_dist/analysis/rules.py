@@ -190,6 +190,18 @@ RULES: dict[str, Rule] = {
             "'Crash forensics')",
         ),
         Rule(
+            "TD114",
+            "serving-slo-not-noop",
+            "the traced serving forward step differs between bare "
+            "inference and the full serve telemetry/SLO kit armed "
+            "(streaming latency histograms observing, queue/occupancy "
+            "gauges published, SLO alert engine fired, histogram "
+            "exposition rendered and parsed back, span recorder "
+            "tapped) — serving observability must stay host-side "
+            "arithmetic around the unmodified compiled step "
+            "(tpu_dist/serve contract, docs/serving.md)",
+        ),
+        Rule(
             "TD104",
             "quantized-wire-bytes-over-budget",
             "gradient-collective payload bytes of a quantized wire format "
@@ -279,11 +291,13 @@ RANK_VAR_NAMES = {"rank", "local_rank", "process_id", "proc_id", "process_index"
 
 # Modules exempt from TD002: host-side tooling that never runs inside a
 # multi-process training job (the analysis and obs CLIs' report output,
-# and the fleet controller — the scheduler/drill/capacity census run in
+# the fleet controller — the scheduler/drill/capacity census run in
 # the single arbiter/launcher process, whose FILES are the control
-# channel the runs' probes read).
+# channel the runs' probes read — and the serve CLI/drill, which run in
+# the single serving/operator process).
 TD002_EXEMPT_PARTS = (
     "tpu_dist/analysis/", "tpu_dist/obs/__main__.py", "tpu_dist/fleet/",
+    "tpu_dist/serve/__main__.py", "tpu_dist/serve/drill.py",
 )
 
 # TD007 allowlist: the designated output layer (rank0_print/get_logger and
@@ -296,6 +310,8 @@ TD007_ALLOWED_PARTS = (
     "tpu_dist/metrics/meters.py",
     "tpu_dist/analysis/",
     "tpu_dist/obs/__main__.py",
+    "tpu_dist/serve/__main__.py",
+    "tpu_dist/serve/drill.py",
 )
 
 # TD003 scope: jit calls inside these factory-name patterns are "hot path".

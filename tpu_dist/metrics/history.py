@@ -34,7 +34,11 @@ allocations before/after and the scraped signals that justified it); v9
 added the forensics layer — the ``postmortem`` kind (a crash bundle
 assembled from a dead run's leftover files: per-rank verdicts, stuck
 frames, last flight-ring steps — ``obs/postmortem.py``, appended by the
-watchdog's auto-invoke rather than by the dying run itself)
+watchdog's auto-invoke rather than by the dying run itself); v10 added
+the serving layer — the ``serve`` kind (one SLO observation window per
+record: latency percentile bounds, requests/s, availability, batch
+occupancy, per-phase latency sums, a compact latency histogram —
+``tpu_dist/serve``, docs/serving.md)
 (docs/observability.md). Consumers (``obs summarize``/``compare``) read
 all versions: every addition is a new kind or optional field, never a
 changed one, and readers skip-with-count kinds they don't know — so a
@@ -57,13 +61,13 @@ import jax
 
 from tpu_dist.obs import counters as counters_lib
 
-SCHEMA_VERSION = 9  # v9 (additive): 'postmortem' crash-bundle records
-#                     (per-rank verdicts, stuck frames, last flight-ring
-#                     steps — appended by the watchdog/CLI assembler,
-#                     docs/observability.md "Crash forensics"); v8 added
-#                     'fleet' scheduler-decision records; v7 added
-#                     'resume' segment-boundary records (world size,
-#                     elastic reshard flag, re-entry position)
+SCHEMA_VERSION = 10  # v10 (additive): 'serve' serving-SLO window records
+#                      (latency percentile bounds, requests/s,
+#                      availability, batch occupancy, phase sums, compact
+#                      latency histogram — tpu_dist/serve/engine.py,
+#                      docs/serving.md); v9 added 'postmortem'
+#                      crash-bundle records; v8 'fleet' scheduler
+#                      decisions; v7 'resume' segment boundaries
 
 
 class MetricsHistory:

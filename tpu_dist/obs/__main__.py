@@ -21,13 +21,15 @@ Subcommands::
         from the run's drained spans + synthesized epoch/eval bars.
 
     compare <baseline.jsonl> <candidate.jsonl> [--threshold 0.05]
-            [--bench] [--goodput] [--format text|json]
+            [--bench] [--goodput] [--slo] [--format text|json]
         Regression gate: diff throughput, step-time percentiles, stall
         fraction, MFU, goodput fraction, and final metrics between two
         runs' logs (or, with --bench, two bench.py JSON outputs).
         --goodput restricts the gate to the time-to-useful-work metrics
-        (run-level goodput_frac + stall fraction). Exits 1 on any
-        regression beyond the threshold — wire it into CI.
+        (run-level goodput_frac + stall fraction); --slo to the serving
+        SLO metrics (requests/s, latency p50/p99, TTFB, availability —
+        lower latency is never flagged). Exits 1 on any regression
+        beyond the threshold — wire it into CI.
 
     pod <host0.jsonl> <host1.jsonl> ... [--heartbeat hb.json ...]
         [--trace-out pod_trace.json] [--format text|json]
@@ -126,6 +128,14 @@ def main(argv=None) -> int:
              "goodput fraction + data-stall fraction); two goodput-less "
              "pre-v4 logs then compare nothing → exit 2, never a silent "
              "pass",
+    )
+    c.add_argument(
+        "--slo", action="store_true",
+        help="gate on the serving SLO metrics only (requests/s, latency "
+             "p50/p99 bounds, TTFB p99, availability, batch occupancy — "
+             "from serve records, schema v10); directions come from the "
+             "metric registry, so a lower-latency candidate is never "
+             "flagged; two serve-less logs compare nothing → exit 2",
     )
     c.add_argument("--format", choices=("text", "json"), default="text")
     pd = sub.add_parser(
@@ -301,7 +311,7 @@ def main(argv=None) -> int:
             result = compare_lib.compare_files(
                 args.baseline, args.candidate,
                 threshold=args.threshold, bench=args.bench,
-                goodput_only=args.goodput,
+                goodput_only=args.goodput, slo_only=args.slo,
             )
         except (OSError, ValueError) as e:
             print(f"tpu_dist.obs: compare failed: {e}", file=sys.stderr)

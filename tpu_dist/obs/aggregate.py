@@ -160,6 +160,10 @@ def pod_report(
             "fleet_decisions": rep.get("fleet_decisions", []),
             # crash bundles (schema v9): how this host's run DIED
             "postmortems": rep.get("postmortems", []),
+            # serving SLO windows (schema v10): this host's serving
+            # latency/rate rollup — last window is the current state
+            "serve_windows": rep.get("serve_windows", []),
+            "serve_events": rep.get("serve_events", []),
         })
     fracs = [
         h["goodput"]["goodput_frac"] for h in hosts
@@ -316,6 +320,25 @@ def format_text(report: dict) -> str:
                 f"  epoch {p.get('epoch')} ({p.get('reason')}): capture "
                 f"FAILED: {p.get('error')}"
             )
+    # per-host serving rollup (schema v10): the LAST window is the
+    # host's current SLO state; mid-serve retraces are called out
+    for h in report["hosts"]:
+        sw = h.get("serve_windows") or []
+        if not sw:
+            continue
+        last = sw[-1]
+        retraces = sum(
+            1 for e in h.get("serve_events") or []
+            if e.get("event") == "retrace"
+        )
+        lines.append(
+            f"serving on {h['host']}: {len(sw)} window(s), last "
+            f"{cell(last.get('requests_per_s'), '.1f', 0).strip()} req/s, "
+            f"p99 {cell(last.get('latency_p99_ms'), '.2f', 0).strip()} ms, "
+            "avail "
+            f"{cell(last.get('availability'), '.3f', 0).strip()}"
+            + (f" — {retraces} mid-serve RETRACE(S)" if retraces else "")
+        )
     for s in report.get("epoch_skew", []):
         mark = " <-- STRAGGLER" if s["skew"] > 1.5 else ""
         lines.append(

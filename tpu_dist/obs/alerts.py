@@ -144,17 +144,20 @@ BUILTIN_RULES: Dict[str, AlertRule] = {
 _RULE_FIELDS = {f.name for f in dataclasses.fields(AlertRule)}
 
 
-def _rule_from_dict(d: dict, idx: int) -> AlertRule:
+def _rule_from_dict(
+    d: dict, idx: int, builtins: Optional[Dict[str, AlertRule]] = None
+) -> AlertRule:
     d = dict(d)
     base: Optional[AlertRule] = None
+    library = builtins if builtins is not None else BUILTIN_RULES
     builtin = d.pop("builtin", None)
     if builtin is not None:
-        if builtin not in BUILTIN_RULES:
+        if builtin not in library:
             raise ValueError(
                 f"rule #{idx}: unknown builtin {builtin!r}; have "
-                f"{sorted(BUILTIN_RULES)}"
+                f"{sorted(library)}"
             )
-        base = BUILTIN_RULES[builtin]
+        base = library[builtin]
     unknown = set(d) - _RULE_FIELDS
     if unknown:
         raise ValueError(
@@ -217,13 +220,18 @@ def _parse_toml_minimal(text: str, path: str) -> List[dict]:
     return rules
 
 
-def load_rules(spec: str) -> List[AlertRule]:
+def load_rules(
+    spec: str, builtins: Optional[Dict[str, AlertRule]] = None
+) -> List[AlertRule]:
     """``--alert_rules`` → validated rule list.  ``default``/``builtin``
     loads the library; otherwise the value is a ``.toml``/``.json`` path.
     Raises ValueError on a malformed spec (the trainer calls this at
-    construction so a typo fails before any model/data work)."""
+    construction so a typo fails before any model/data work).
+    ``builtins`` overrides the library ``builtin =`` references resolve
+    against (and what ``default`` returns) — the serving SLO loader
+    passes the merged training+serving set (``serve/slo.py``)."""
     if spec in ("default", "builtin"):
-        return list(BUILTIN_RULES.values())
+        return list((builtins if builtins is not None else BUILTIN_RULES).values())
     if spec.endswith(".json"):
         with open(spec) as f:
             data = json.load(f)
@@ -245,7 +253,7 @@ def load_rules(spec: str) -> List[AlertRule]:
     if not isinstance(raw, list) or not raw:
         raise ValueError(f"{spec}: expected a non-empty list of [[rule]] tables")
     rules = [
-        _rule_from_dict(d, i) for i, d in enumerate(raw)
+        _rule_from_dict(d, i, builtins) for i, d in enumerate(raw)
         if isinstance(d, dict) or _bad_entry(spec, i, d)
     ]
     names = [r.name for r in rules]

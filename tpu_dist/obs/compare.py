@@ -34,44 +34,114 @@ from typing import List, Optional, Tuple
 
 from tpu_dist.obs import summarize as summ
 
-#: history-mode metrics: (key, direction, absolute slack). Direction is
-#: which way is BETTER; slack is added to the relative allowance.
-REPORT_METRICS: Tuple[Tuple[str, str, float], ...] = (
-    ("images_per_sec_mean", "higher", 0.0),
-    ("step_time_p50_s", "lower", 0.0),
-    ("step_time_p95_s", "lower", 0.0),
-    ("step_time_p99_s", "lower", 0.0),
-    ("data_stall_frac", "lower", 0.02),
-    ("mfu_mean", "higher", 0.005),
-    ("final_loss", "lower", 0.02),
-    ("final_val_top1", "higher", 0.5),
-    ("goodput_frac", "higher", 0.01),
+#: ONE metric-direction registry: ``name -> (direction, absolute slack)``
+#: for every scalar any compare mode gates on. Direction is which way is
+#: BETTER (``lower`` = latency-style, ``higher`` = throughput-style);
+#: slack is added to the relative allowance (noise floor — fractions
+#: move in absolute points on quiet runs). The metric tables below
+#: (history / bench / ``--slo``) all derive from this registry via
+#: :func:`direction_of`, so a new latency or queue metric declares its
+#: direction ONCE instead of hand-rolling it per comparison (the
+#: overlap/collective special-casing of PR 8, generalized).
+METRIC_DIRECTIONS: dict = {
+    "images_per_sec_mean": ("higher", 0.0),
+    "step_time_p50_s": ("lower", 0.0),
+    "step_time_p95_s": ("lower", 0.0),
+    "step_time_p99_s": ("lower", 0.0),
+    "data_stall_frac": ("lower", 0.02),
+    "mfu_mean": ("higher", 0.005),
+    "final_loss": ("lower", 0.02),
+    "final_val_top1": ("higher", 0.5),
+    "goodput_frac": ("higher", 0.01),
     # capture-derived schedule health (obs/xprof.py, profile_analysis
     # records): mean comm/compute overlap — LOWER overlap means newly
     # serialized collectives — and the collectives' share of device busy
     # time, which growing means the step got more communication-bound.
-    # Absolute slacks because both are fractions that wobble a few points
-    # run to run on quiet captures.
-    ("overlap_frac", "higher", 0.05),
-    ("collective_frac", "lower", 0.03),
-)
+    # Absolute slacks because both are fractions that wobble a few
+    # points run to run on quiet captures.
+    "overlap_frac": ("higher", 0.05),
+    "collective_frac": ("lower", 0.03),
+    # bench-mode per-record fields
+    "value": ("higher", 0.0),          # images/sec (or tokens/sec)
+    "sec_per_epoch": ("lower", 0.0),
+    "step_ms": ("lower", 0.0),
+    "step_ms_p50": ("lower", 0.0),
+    "step_ms_p95": ("lower", 0.0),
+    "step_ms_p99": ("lower", 0.0),
+    "mfu": ("higher", 0.005),
+    # serving (``--slo`` gate + bench --serve records, serve/slo.py):
+    # latency/queue metrics are lower-is-better; a LOWER-latency
+    # candidate is an improvement and must never be flagged.
+    "requests_per_s": ("higher", 0.0),
+    "serve_requests_per_s": ("higher", 0.0),
+    "latency_p50_ms": ("lower", 0.0),
+    "latency_p99_ms": ("lower", 0.0),
+    "serve_latency_p50_ms": ("lower", 0.0),
+    "serve_latency_p99_ms": ("lower", 0.0),
+    "serve_ttfb_p99_ms": ("lower", 0.0),
+    "serve_availability": ("higher", 0.001),
+    "batch_occupancy": ("higher", 0.02),
+    "serve_batch_occupancy": ("higher", 0.02),
+    "serve_queue_depth_max": ("lower", 1.0),
+}
+
+
+def direction_of(metric: str) -> Tuple[str, float]:
+    """Registry lookup with two documented suffix defaults: ``*_ms`` /
+    ``*_s`` / ``*_seconds`` metrics are latencies (lower is better,
+    zero slack), ``*_per_s`` are rates (higher). Anything else must be
+    registered explicitly — an unknown direction silently guessed wrong
+    would invert a gate, so this raises instead."""
+    hit = METRIC_DIRECTIONS.get(metric)
+    if hit is not None:
+        return hit
+    if metric.endswith("_per_s"):
+        return ("higher", 0.0)
+    if metric.endswith(("_ms", "_s", "_seconds")):
+        return ("lower", 0.0)
+    raise KeyError(
+        f"metric {metric!r} has no registered direction "
+        "(obs/compare.py METRIC_DIRECTIONS) and no suffix default"
+    )
+
+
+def _table(names: Tuple[str, ...]) -> Tuple[Tuple[str, str, float], ...]:
+    return tuple((n, *direction_of(n)) for n in names)
+
+
+#: history-mode metrics: (key, direction, absolute slack), derived from
+#: the registry.
+REPORT_METRICS: Tuple[Tuple[str, str, float], ...] = _table((
+    "images_per_sec_mean", "step_time_p50_s", "step_time_p95_s",
+    "step_time_p99_s", "data_stall_frac", "mfu_mean", "final_loss",
+    "final_val_top1", "goodput_frac", "overlap_frac", "collective_frac",
+))
 
 #: the ``--goodput`` gate's metric set: time-to-useful-work only. The
 #: fraction is the headline; the stall fraction rides along because a
 #: goodput regression's most common cause is an input-pipeline change.
 GOODPUT_METRICS: Tuple[str, ...] = ("goodput_frac", "data_stall_frac")
 
+#: the ``--slo`` gate's metric set (serving runs, ``serve`` records):
+#: request rate, latency ceilings (upper-bound quantiles in ms),
+#: availability, and batching efficiency — directions from the registry,
+#: so lower latency is NEVER flagged.
+SLO_METRICS: Tuple[Tuple[str, str, float], ...] = _table((
+    "serve_requests_per_s", "serve_latency_p50_ms",
+    "serve_latency_p99_ms", "serve_ttfb_p99_ms", "serve_availability",
+    "serve_batch_occupancy",
+))
+
 #: bench-mode per-record fields: (field, direction, absolute slack).
-BENCH_FIELDS: Tuple[Tuple[str, str, float], ...] = (
-    ("value", "higher", 0.0),          # images/sec (or tokens/sec)
-    ("sec_per_epoch", "lower", 0.0),
-    ("step_ms", "lower", 0.0),
-    ("step_ms_p50", "lower", 0.0),
-    ("step_ms_p95", "lower", 0.0),
-    ("step_ms_p99", "lower", 0.0),
-    ("mfu", "higher", 0.005),
-    ("goodput_frac", "higher", 0.02),
-)
+#: ``goodput_frac`` keeps bench's historical wider slack (bench windows
+#: are short, the fraction noisier than a whole run's ledger).
+BENCH_FIELDS: Tuple[Tuple[str, str, float], ...] = _table((
+    "value", "sec_per_epoch", "step_ms", "step_ms_p50", "step_ms_p95",
+    "step_ms_p99", "mfu",
+    # serving bench records (bench.py --serve)
+    "requests_per_s", "latency_p50_ms", "latency_p99_ms",
+    "batch_occupancy",
+)) + (("goodput_frac", "higher", 0.02),)
 
 
 def _mean(vals: List) -> Optional[float]:
@@ -92,6 +162,7 @@ def report_scalars(report: dict) -> dict:
         p for p in (report.get("profile_analyses") or [])
         if not p.get("error")
     ]
+    sw = report.get("serve_windows") or []
     return {
         "images_per_sec_mean": report["totals"].get("images_per_sec_mean"),
         "step_time_p50_s": _mean([r.get("step_time_p50_s") for r in epochs]),
@@ -108,6 +179,15 @@ def report_scalars(report: dict) -> dict:
         # therefore a skipped row, never a fake pass — on capture-less runs
         "overlap_frac": _mean([p.get("overlap_frac") for p in pas]),
         "collective_frac": _mean([p.get("collective_frac") for p in pas]),
+        # serving SLO means over the run's serve windows (schema v10);
+        # None — skipped, never faked — on a training-only log. The
+        # ``--slo`` gate compares exactly these (SLO_METRICS).
+        "serve_requests_per_s": _mean([w.get("requests_per_s") for w in sw]),
+        "serve_latency_p50_ms": _mean([w.get("latency_p50_ms") for w in sw]),
+        "serve_latency_p99_ms": _mean([w.get("latency_p99_ms") for w in sw]),
+        "serve_ttfb_p99_ms": _mean([w.get("ttfb_p99_ms") for w in sw]),
+        "serve_availability": _mean([w.get("availability") for w in sw]),
+        "serve_batch_occupancy": _mean([w.get("batch_occupancy") for w in sw]),
     }
 
 
@@ -135,12 +215,15 @@ def _row(
 
 def compare_scalars(
     base: dict, cand: dict, threshold: float = 0.05,
-    goodput_only: bool = False,
+    goodput_only: bool = False, slo_only: bool = False,
 ) -> dict:
-    metrics = [
-        m for m in REPORT_METRICS
-        if not goodput_only or m[0] in GOODPUT_METRICS
-    ]
+    if slo_only:
+        metrics = list(SLO_METRICS)
+    else:
+        metrics = [
+            m for m in REPORT_METRICS
+            if not goodput_only or m[0] in GOODPUT_METRICS
+        ]
     rows = [
         _row(key, direction, slack, base.get(key), cand.get(key), threshold)
         for key, direction, slack in metrics
@@ -176,13 +259,15 @@ def capture_fingerprint(rec: dict) -> Optional[tuple]:
 
 def load_history_scalars(path: str) -> dict:
     """``--log_file`` JSONL → comparable scalars; raises ValueError on an
-    empty/unusable file (a gate comparing nothing must fail loudly)."""
+    empty/unusable file (a gate comparing nothing must fail loudly). A
+    serving-only log (``serve`` windows, no ``train_epoch`` records) is
+    usable — the ``--slo`` gate compares exactly those."""
     records, _bad = summ.load_records(path)
     if not records:
         raise ValueError(f"no records in {path}")
     report = summ.summarize(records)
-    if not report["epochs"]:
-        raise ValueError(f"no train_epoch records in {path}")
+    if not report["epochs"] and not report.get("serve_windows"):
+        raise ValueError(f"no train_epoch or serve records in {path}")
     scalars = report_scalars(report)
     scalars["_run_id"] = report.get("run_id")
     return scalars
@@ -241,20 +326,24 @@ def compare_bench(base: dict, cand: dict, threshold: float = 0.05) -> dict:
 def compare_files(
     baseline: str, candidate: str, *,
     threshold: float = 0.05, bench: bool = False,
-    goodput_only: bool = False,
+    goodput_only: bool = False, slo_only: bool = False,
 ) -> dict:
     """The CLI engine: load both inputs and diff. Raises OSError on an
     unreadable file and ValueError on an unusable one — the caller maps
     both to exit 2 (a broken gate, distinct from exit 1's regression).
     ``goodput_only`` (the ``--goodput`` flag) restricts the gate to the
-    time-to-useful-work metrics; inputs without goodput records then
-    compare nothing, which the CLI surfaces as a broken gate (exit 2)
-    rather than a silent pass."""
-    if bench and goodput_only:
+    time-to-useful-work metrics; ``slo_only`` (``--slo``) to the serving
+    SLO metrics (``serve`` records, directions from the registry — a
+    lower-latency candidate is never flagged). Inputs without the
+    gated records then compare nothing, which the CLI surfaces as a
+    broken gate (exit 2) rather than a silent pass."""
+    if bench and (goodput_only or slo_only):
         raise ValueError(
-            "--goodput gates the history-mode run ledger; bench records "
-            "carry goodput_frac as an ordinary compared field instead"
+            "--goodput/--slo gate history-mode logs; bench records carry "
+            "their serving/goodput fields as ordinary compared fields"
         )
+    if goodput_only and slo_only:
+        raise ValueError("--goodput and --slo are separate gates; pick one")
     if bench:
         result = compare_bench(
             load_bench_records(baseline), load_bench_records(candidate),
@@ -263,7 +352,9 @@ def compare_files(
     else:
         b = load_history_scalars(baseline)
         c = load_history_scalars(candidate)
-        result = compare_scalars(b, c, threshold, goodput_only=goodput_only)
+        result = compare_scalars(
+            b, c, threshold, goodput_only=goodput_only, slo_only=slo_only,
+        )
         result["baseline_run_id"] = b.get("_run_id")
         result["candidate_run_id"] = c.get("_run_id")
     result["baseline"] = baseline

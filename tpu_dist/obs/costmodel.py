@@ -167,40 +167,82 @@ class CompileWatcher:
     """Turn a jitted step's executable-cache growth into compile telemetry.
 
     jax keeps one compiled executable per (shape, dtype, static-arg)
-    signature; the cache growing past the first entry mid-run means the
-    step RETRACED — usually shape/dtype drift in the input pipeline, and
-    on a pod each retrace is a full XLA compile stall on every host. The
-    trainer calls :meth:`observe` once per step (one C++ attribute read —
-    no device work, no sync): every growth increments ``compile.events``,
-    growth after the first dispatch additionally increments
-    ``compile.retraces`` and returns True so the caller can warn on
-    rank 0. ``obs summarize`` surfaces the per-epoch retrace delta.
+    signature; the cache growing past the expected warmup mid-run means
+    the step RETRACED — usually shape/dtype drift in the input pipeline,
+    and on a pod each retrace is a full XLA compile stall on every host.
+    Callers invoke :meth:`observe` once per step (one C++ attribute
+    read — no device work, no sync): every growth increments
+    ``compile.events``; growth after the first dispatch (or after
+    :meth:`baseline`) additionally increments ``compile.retraces``,
+    prints the rank-0 warning, and returns True. The warning and the
+    counters live HERE — the trainer, the serving engine, and any future
+    caller get the same surfacing for free; ``obs summarize`` reports
+    the per-epoch retrace delta.
+
+    Multi-signature callers (the serving engine compiles one executable
+    per batch bucket at warmup) call :meth:`baseline` after their warmup
+    pass: the compiles so far are absorbed as expected (counted into
+    ``compile.events``, never as retraces) and EVERY later growth is a
+    retrace.
 
     Degrades to a permanent no-op when the callable has no
     ``_cache_size`` (a non-jit wrapper, or a jax that dropped the
     private API) — observation must never break the step loop."""
 
-    def __init__(self, jitted):
+    def __init__(self, jitted, name: str = "train step", warn: bool = True):
         self._size_fn = getattr(jitted, "_cache_size", None)
         self._seen = 0
+        self._baselined = False
+        self.name = name
+        self.warn = warn
 
-    def observe(self) -> bool:
-        """Record any new compiles; True when one was a mid-run retrace."""
+    def _size(self) -> Optional[int]:
         if self._size_fn is None:
-            return False
+            return None
         try:
-            size = int(self._size_fn())
+            return int(self._size_fn())
         except Exception:
             self._size_fn = None
+            return None
+
+    def baseline(self) -> int:
+        """Absorb every compile so far as expected warmup: counts them
+        into ``compile.events`` but never as retraces, and marks the
+        watcher so ANY later growth is one. Returns the absorbed count."""
+        size = self._size()
+        if size is None:
+            return 0
+        grew = max(size - self._seen, 0)
+        if grew:
+            counters_lib.inc("compile.events", grew)
+        self._seen = max(size, self._seen)
+        self._baselined = True
+        return grew
+
+    def observe(self, context: str = "") -> bool:
+        """Record any new compiles; True when one was a mid-run retrace.
+        On a retrace the watcher itself prints the rank-0 warning
+        (``warn=False`` to suppress); ``context`` names the position
+        (``"epoch 3 step 12"``) in it."""
+        size = self._size()
+        if size is None or size <= self._seen:
             return False
-        if size <= self._seen:
-            return False
-        grew, first = size - self._seen, self._seen == 0
+        grew = size - self._seen
+        first = self._seen == 0 and not self._baselined
         self._seen = size
         counters_lib.inc("compile.events", grew)
         retraces = grew - 1 if first else grew
         if retraces > 0:
             counters_lib.inc("compile.retraces", retraces)
+            if self.warn:
+                from tpu_dist.metrics.logging import rank0_print  # noqa: PLC0415
+
+                rank0_print(
+                    f"WARNING: {self.name} RECOMPILED"
+                    + (f" at {context}" if context else "")
+                    + " — input shape/dtype drift? (compile.retraces="
+                    f"{counters_lib.get('compile.retraces'):g})"
+                )
             return True
         return False
 

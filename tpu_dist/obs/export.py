@@ -85,15 +85,23 @@ def render(
     values: Dict[str, float],
     labeled: Optional[Dict[str, Dict[str, float]]] = None,
     label_keys: Optional[Dict[str, str]] = None,
+    histograms: Optional[Dict[str, dict]] = None,
 ) -> str:
     """Render one exposition: ``values`` maps raw (dotted) metric names to
     numbers; ``labeled`` maps raw names to ``{label_value: number}``
     samples emitted as ``name{<key>="..."}`` — the label key per family
     comes from ``label_keys`` and defaults to ``rule`` (the alert gauges,
     the original labeled family; the fleet scheduler passes ``run``).
-    Non-numeric registry entries (info gauges — run id, mode strings) are
-    skipped: OpenMetrics samples are numbers.  Ends with the mandatory
-    ``# EOF``."""
+    ``histograms`` maps raw names to the OpenMetrics ``histogram`` shape
+    (``{"buckets": [(le, cumulative_count), ...], "sum": s, "count": n}``
+    — ``serve/slo.py::LatencyHistogram.to_openmetrics``), emitted as
+    ``name_bucket{le="..."}`` / ``name_sum`` / ``name_count`` so a
+    Prometheus computes real ``histogram_quantile()``s over the serving
+    latencies; the bucket list must already be cumulative and end with
+    ``+Inf`` (the producer's contract — this renderer is a formatter,
+    not a validator). Non-numeric registry entries (info gauges — run
+    id, mode strings) are skipped: OpenMetrics samples are numbers.
+    Ends with the mandatory ``# EOF``."""
     lines = []
     for raw in sorted(values):
         v = values[raw]
@@ -109,6 +117,14 @@ def render(
         for label, v in sorted((labeled or {})[raw].items()):
             safe = str(label).replace("\\", "\\\\").replace('"', '\\"')
             lines.append(f'{name}{{{key}="{safe}"}} {_fmt_value(v)}')
+    for raw in sorted(histograms or {}):
+        fam = (histograms or {})[raw]
+        name = metric_name(raw)
+        lines.append(f"# TYPE {name} histogram")
+        for le, cum in fam.get("buckets") or []:
+            lines.append(f'{name}_bucket{{le="{le}"}} {_fmt_value(cum)}')
+        lines.append(f"{name}_sum {_fmt_value(float(fam.get('sum', 0.0)))}")
+        lines.append(f"{name}_count {_fmt_value(int(fam.get('count', 0)))}")
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
@@ -209,14 +225,16 @@ class MetricsExporter:
         values: Dict[str, float],
         labeled: Optional[Dict[str, Dict[str, float]]] = None,
         *,
+        histograms: Optional[Dict[str, dict]] = None,
         force: bool = False,
     ) -> bool:
         """Publish a new exposition.  Returns True when the textfile was
         (re)written — inside the throttle window only the in-memory HTTP
         snapshot moves (it is free), matching the heartbeat's step-grain
-        discipline.  Never raises on I/O: a full disk must not kill the
-        training step that exported."""
-        text = render(values, labeled)
+        discipline.  ``histograms`` adds OpenMetrics histogram families
+        (the serving latency distributions).  Never raises on I/O: a
+        full disk must not kill the training step that exported."""
+        text = render(values, labeled, histograms=histograms)
         with self._lock:
             self._body = text.encode()
         if not self.textfile:

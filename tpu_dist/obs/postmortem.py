@@ -56,7 +56,7 @@ BUNDLE_NAME = "postmortem.json"
 #: holding the copied files must not need a backend); pinned to the
 #: real SCHEMA_VERSION by ``tests/test_flight.py`` — the fleet-module
 #: discipline (``FLEET_SCHEMA_VERSION``).
-POSTMORTEM_SCHEMA_VERSION = 9
+POSTMORTEM_SCHEMA_VERSION = 10
 
 #: Artifact stems recognized during discovery; each may carry the
 #: ``.h<k>`` per-rank suffix. History files are any ``*.jsonl``.

@@ -29,14 +29,14 @@ from tpu_dist.obs import goodput as goodput_lib
 #: kinds summarized; their unknown kinds are skipped with a count — the
 #: forward-compat contract that lets v3 tooling read v4 logs and vice
 #: versa (every schema bump is additive).
-SUPPORTED_SCHEMA = 9
+SUPPORTED_SCHEMA = 10
 
 #: Record kinds this reader folds into the report. Anything else is
 #: counted into ``skipped_kinds`` — never an error, never silent.
 KNOWN_KINDS = frozenset((
     "train_epoch", "eval", "straggler", "anomaly", "device_stats",
     "auto_recover", "spans", "goodput", "profile", "alert",
-    "profile_analysis", "resume", "fleet", "postmortem",
+    "profile_analysis", "resume", "fleet", "postmortem", "serve",
 ))
 
 
@@ -77,6 +77,8 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
     world_sizes: List[int] = []  # distinct dp extents, in order of appearance
     fleet_decisions: List[dict] = []  # scheduler chip moves (schema v8)
     postmortems: List[dict] = []  # crash bundles (schema v9)
+    serve_windows: List[dict] = []  # serving SLO windows (schema v10)
+    serve_events: List[dict] = []   # serving events (mid-serve retraces)
     dstats: dict = {}  # epoch -> per-epoch device_stats aggregate
     recoveries = 0
     prev_counters: Optional[dict] = None
@@ -183,6 +185,29 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
                           "fatal", "last_steps")
                 if rec.get(k) is not None
             })
+        elif kind == "serve":
+            # a serving SLO window (schema v10, serve/engine.py): latency
+            # percentile bounds, request rate, availability, batching
+            # efficiency — or a mid-serve event (retrace) stamped by the
+            # engine's pump
+            if rec.get("event"):
+                serve_events.append({
+                    k: rec.get(k)
+                    for k in ("event", "bucket", "n_real")
+                    if rec.get(k) is not None
+                })
+            else:
+                serve_windows.append({
+                    k: rec.get(k)
+                    for k in ("window_s", "requests", "completed",
+                              "requests_per_s", "latency_p50_ms",
+                              "latency_p95_ms", "latency_p99_ms",
+                              "ttfb_p50_ms", "ttfb_p99_ms",
+                              "availability", "batch_occupancy",
+                              "batches", "queue_depth",
+                              "queue_depth_max", "retraces", "phase_s")
+                    if rec.get(k) is not None
+                })
         elif kind == "profile":
             profiles.append({
                 k: rec.get(k)
@@ -273,6 +298,8 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
         "world_sizes": world_sizes,
         "fleet_decisions": fleet_decisions,
         "postmortems": postmortems,
+        "serve_windows": serve_windows,
+        "serve_events": serve_events,
         "stragglers": stragglers,
         "anomalies": anomalies,
         "alerts": alerts,
@@ -498,6 +525,22 @@ def format_text(report: dict) -> str:
                     f"      WARNING: {n} trace file(s) dropped during "
                     f"analysis ({pa['dropped']})"
                 )
+    sw = report.get("serve_windows") or []
+    if sw:
+        # the table through the ONE shared renderer (serve/slo.py —
+        # jax-free): the offline serve report and this view can never
+        # drift column by column
+        from tpu_dist.serve.slo import window_table_lines
+
+        lines.append("serving SLO windows (serve/slo.py, schema v10):")
+        lines.extend(window_table_lines(sw))
+    for ev in report.get("serve_events") or []:
+        if ev.get("event") == "retrace":
+            lines.append(
+                f"serve: RETRACE on a bucket-{ev.get('bucket')} batch "
+                f"({ev.get('n_real')} real request(s)) — the compiled "
+                "forward saw a new shape mid-serve"
+            )
     gp_epochs = report.get("goodput_epochs") or []
     if gp_epochs:
         lines.append("goodput (seconds per window):")

@@ -225,6 +225,31 @@ class TailState:
                         if rec.get("reason") else ""
                     )
                 )
+            elif kind == "serve":
+                # a serving SLO window (schema v10) or a mid-serve event
+                # (retrace) — one line each, the serving analogue of the
+                # epoch row
+                if rec.get("event") == "retrace":
+                    self._event(
+                        f"serve RETRACE: bucket-{rec.get('bucket')} batch "
+                        f"({rec.get('n_real')} real) recompiled mid-serve"
+                    )
+                else:
+                    fmt = lambda v, s: (  # noqa: E731
+                        format(v, s) if isinstance(v, (int, float)) else "-"
+                    )
+                    self._event(
+                        f"serve: {fmt(rec.get('requests_per_s'), '.1f')} "
+                        f"req/s, p50 {fmt(rec.get('latency_p50_ms'), '.2f')} "
+                        f"ms, p99 {fmt(rec.get('latency_p99_ms'), '.2f')} ms, "
+                        f"avail {fmt(rec.get('availability'), '.3f')}, "
+                        f"occupancy {fmt(rec.get('batch_occupancy'), '.2f')}, "
+                        f"queue≤{fmt(rec.get('queue_depth_max'), 'g')}"
+                        + (
+                            f" — {rec['retraces']:g} RETRACE(S)"
+                            if rec.get("retraces") else ""
+                        )
+                    )
             elif kind == "postmortem":
                 # a crash bundle landed (schema v9, the watchdog's
                 # auto-invoke): the run did NOT end cleanly — render the

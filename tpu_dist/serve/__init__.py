@@ -1,0 +1,25 @@
+"""Serving subsystem — compiled inference with continuous batching and a
+latency-instrumented SLO layer (``docs/serving.md``).
+
+Layout:
+
+* ``engine.py`` — the inference path: jit-compiled forward step per model
+  family (``tpu_dist.nn`` ResNet/ViT), a request queue with dynamic batch
+  assembly into power-of-two pad-to-bucket shapes (zero steady-state
+  retraces, proven by ``obs/costmodel.py::CompileWatcher``), checkpoint →
+  serving-weights loading through the existing restore ladder (the
+  elastic ``Remapper`` makes any training-time mesh shape loadable), and
+  optional int8 weight quantization (``comm/quantize.py`` machinery).
+* ``slo.py`` — the observability headline: jax-free streaming latency
+  histograms (fixed log-spaced buckets, mergeable, O(1) memory),
+  per-phase request latency stats, declarative SLO rules riding the
+  ``obs/alerts.py`` engine, and the serve report.
+* ``drill.py`` — ``make serve-drill``: deterministic request-trace replay
+  proving zero post-warmup retraces, histogram invariants, and the
+  ``obs compare --slo`` exit contract.
+* ``__main__.py`` — ``python -m tpu_dist.serve {report,drill}``.
+
+The jaxpr-audit rule TD114 pins the cost contract: arming every piece of
+the serve telemetry/SLO machinery leaves the traced forward step
+byte-identical to bare inference.
+"""

@@ -1,0 +1,77 @@
+"""CLI: ``python -m tpu_dist.serve`` — serving reports and the drill.
+
+Subcommands::
+
+    report <run.jsonl> [--format text|json]
+        Offline serving SLO report from a history JSONL's ``serve``
+        records (schema v10): the per-window table (requests/s, latency
+        p50/p99 bounds, TTFB, availability, batch occupancy, queue
+        depth), the SLO alerts that fired, and the final latency
+        histogram. Exit 1 when the log holds no serve records.
+
+    drill [--workdir DIR] [--format text|json]
+        The serving proof (``serve/drill.py`` / ``make serve-drill``):
+        deterministic request-trace replay — checkpoint → serving
+        weights through the elastic Remapper, zero post-warmup retraces,
+        histogram invariants, and the ``obs compare --slo`` exit
+        contract (injected regression exits 1, an improvement exits 0).
+
+Exit codes: 0 ok, 1 unusable input / failed drill, 2 bad invocation.
+The report path is pure file crunching — no device, no backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_dist.serve",
+        description="serving SLO reports and the deterministic serve drill",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    r = sub.add_parser(
+        "report", help="per-window serving SLO report from a --log_file JSONL"
+    )
+    r.add_argument("log", help="history JSONL holding serve records")
+    r.add_argument("--format", choices=("text", "json"), default="text")
+    d = sub.add_parser(
+        "drill", help="deterministic serving drill (make serve-drill)"
+    )
+    d.add_argument("--workdir", default="/tmp/serve_drill")
+    d.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "drill":
+        from tpu_dist.serve import drill as drill_lib
+
+        return drill_lib.main(
+            ["--workdir", args.workdir, "--format", args.format]
+        )
+
+    from tpu_dist.obs.summarize import load_records
+    from tpu_dist.serve import slo as slo_lib
+
+    try:
+        records, _bad = load_records(args.log)
+    except OSError as e:
+        print(f"tpu_dist.serve: cannot read {args.log}: {e}",
+              file=sys.stderr)
+        return 2
+    report = slo_lib.serve_report(records)
+    if not report["n_windows"]:
+        print(f"tpu_dist.serve: no serve records in {args.log}",
+              file=sys.stderr)
+        return 1
+    if args.format == "json":
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(slo_lib.format_report_text(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,584 @@
+"""The inference path — compiled forward steps, continuous batching, and
+checkpoint → serving-weights loading (``docs/serving.md``).
+
+Design constraints, in order:
+
+1. **Zero steady-state retraces.** Requests arrive in arbitrary counts;
+   the batcher pads every assembled batch up to a **power-of-two bucket**
+   (``1, 2, 4, …, max_batch``), so the jitted forward only ever sees
+   ``log2(max_batch)+1`` distinct shapes — all compiled at
+   :meth:`ServingEngine.warmup`. The proof is not a comment: the engine
+   wraps its jitted step in the existing
+   :class:`~tpu_dist.obs.costmodel.CompileWatcher`; after warmup is
+   baselined, ANY executable-cache growth is a mid-serve retrace — a
+   counted, warned, alertable event (the ``serve_retrace`` SLO rule).
+2. **Latency is attributed, not hidden.** Every request's life is split
+   into the ``slo.PHASES`` (queue_wait / batch_assembly / dispatch /
+   device / fetch) on the engine's injectable clock, feeding the
+   streaming histograms and the span recorder. Batching helps
+   throughput by ADDING queue wait — the split is what makes that
+   trade-off visible per request.
+3. **Same chips, same checkpoints.** Serving weights load through the
+   existing restore ladder (newest→oldest, CRC verify, quarantine
+   on corruption) with the elastic
+   :class:`~tpu_dist.elastic.remap.Remapper` — a checkpoint written at
+   ANY training dp extent restores onto the 1-process serving layout
+   (ZeRO-1 flat optimizer vectors crop bit-exactly; serving then drops
+   the optimizer state anyway). Optional int8 weight quantization
+   reuses the per-chunk-scale machinery of ``comm/quantize.py``:
+   weights live as int8 + f32 scales (≈4× less HBM) and dequantize
+   inside the compiled step.
+
+The jaxpr-audit rule TD114 pins the cost contract: the traced forward
+step is byte-identical with the whole telemetry/SLO kit armed vs bare.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tpu_dist.obs import costmodel as costmodel_lib
+from tpu_dist.obs import counters as counters_lib
+from tpu_dist.obs import spans as spans_lib
+from tpu_dist.serve import slo as slo_lib
+
+
+def batch_buckets(max_batch: int) -> Tuple[int, ...]:
+    """The power-of-two bucket ladder: ``(1, 2, 4, ..., max_batch)``.
+    ``max_batch`` must itself be a power of two — a ragged top bucket
+    would silently re-introduce a retraceable shape."""
+    if max_batch < 1 or max_batch & (max_batch - 1):
+        raise ValueError(
+            f"max_batch must be a power of two (the bucket ladder), "
+            f"got {max_batch}"
+        )
+    out = []
+    b = 1
+    while b <= max_batch:
+        out.append(b)
+        b *= 2
+    return tuple(out)
+
+
+def bucket_for(n: int, buckets: Tuple[int, ...]) -> int:
+    """Smallest bucket holding ``n`` requests (callers cap ``n`` at
+    ``max_batch`` first)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"batch of {n} exceeds the top bucket {buckets[-1]}")
+
+
+class Request:
+    """One in-flight inference request. ``arrival_s`` is on the engine's
+    clock (injectable — the drill replays recorded offsets); phase
+    timestamps are filled in by the pump."""
+
+    __slots__ = (
+        "id", "payload", "arrival_s", "result", "ok",
+        "total_s", "ttfb_s", "phase_s",
+    )
+
+    def __init__(self, id, payload: np.ndarray, arrival_s: float):
+        self.id = id
+        self.payload = payload
+        self.arrival_s = arrival_s
+        self.result: Optional[np.ndarray] = None
+        self.ok = False
+        self.total_s: Optional[float] = None
+        self.ttfb_s: Optional[float] = None
+        self.phase_s: Dict[str, float] = {}
+
+
+# -- int8 weight quantization ------------------------------------------------
+
+
+def quantize_weights(params, chunk: Optional[int] = None):
+    """Per-leaf int8 quantization of a parameter pytree: each leaf is
+    raveled and quantized per-chunk (``comm/quantize.py`` — one f32
+    scale per ``chunk`` int8 elements, deterministic round-to-nearest:
+    serving must be reproducible, so no stochastic rounding). Returns
+    ``(qtree, shapes)``: a pytree of ``{"q": int8 (m,), "scale": f32
+    (k,)}`` leaves — ~1 byte/elem at rest instead of 4 — and a matching
+    tree of the original leaf shapes. The shapes stay a HOST-side
+    static closure (:func:`dequantize_weights` takes them separately):
+    folding them into the traced tree would turn every dimension into a
+    traced value and break the reshape inside jit."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.comm import quantize as q_lib
+
+    chunk = chunk or q_lib.DEFAULT_CHUNK
+    is_arr = lambda x: not isinstance(x, (dict, list, tuple))  # noqa: E731
+
+    def one(leaf):
+        arr = jnp.asarray(leaf, jnp.float32).ravel()
+        q, scales = q_lib.quantize_int8(arr, chunk=chunk, key=None)
+        return {"q": q, "scale": scales}
+
+    qtree = jax.tree_util.tree_map(one, params, is_leaf=is_arr)
+    shapes = jax.tree_util.tree_map(
+        lambda leaf: tuple(int(d) for d in np.shape(leaf)),
+        params, is_leaf=is_arr,
+    )
+    return qtree, shapes
+
+
+def dequantize_weights(qparams, shapes, chunk: Optional[int] = None):
+    """Inverse of :func:`quantize_weights` — runs INSIDE the jitted
+    forward (the dequantize is compiled into the step; XLA fuses it into
+    the consumers, and the at-rest copy stays int8). ``shapes`` is the
+    static shape tree from :func:`quantize_weights`."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.comm import quantize as q_lib
+
+    chunk = chunk or q_lib.DEFAULT_CHUNK
+
+    def is_q(x):
+        return isinstance(x, dict) and set(x) == {"q", "scale"}
+
+    def one(leaf, shape):
+        out = q_lib.dequantize_int8(leaf["q"], leaf["scale"], chunk=chunk)
+        return jnp.reshape(out, shape)
+
+    return jax.tree_util.tree_map(one, qparams, shapes, is_leaf=is_q)
+
+
+# -- checkpoint → serving weights --------------------------------------------
+
+_KEY_SEG = re.compile(r"\['([^']*)'\]|\[(\d+)\]")
+
+
+def _tree_from_keys(entries: Dict[str, np.ndarray]):
+    """Rebuild a nested dict/list pytree from ``jax.tree_util.keystr``
+    keys (``['a'][0]['b']``) → template leaves. Returns None when a key
+    uses a construct this parser does not cover (attr paths) — the
+    caller then skips mirroring that subtree."""
+    root: dict = {}
+    for key, leaf in entries.items():
+        segs = []
+        pos = 0
+        for m in _KEY_SEG.finditer(key):
+            if m.start() != pos:
+                return None
+            segs.append(m.group(1) if m.group(1) is not None else int(m.group(2)))
+            pos = m.end()
+        if pos != len(key) or not segs:
+            return None
+        node = root
+        for i, seg in enumerate(segs):
+            last = i == len(segs) - 1
+            node = node.setdefault(seg, leaf if last else {})
+
+    def listify(node):
+        if not isinstance(node, dict):
+            return node
+        out = {k: listify(v) for k, v in node.items()}
+        if out and all(isinstance(k, int) for k in out):
+            return [out[i] for i in sorted(out)]
+        return out
+
+    return listify(root)
+
+
+def load_serving_state(
+    ckpt: str,
+    model,
+    *,
+    verify: bool = True,
+    key_seed: int = 0,
+) -> dict:
+    """Checkpoint → serving weights, through the existing restore ladder.
+
+    ``ckpt`` is a plain-format checkpoint file or a ``--ckpt_dir``
+    (walked newest→oldest with the trainer's quarantine discipline: a
+    CRC-failing candidate is moved to ``*.corrupt`` and the next older
+    one tried). ``model`` is an ``nn`` model def (``init``/``apply``).
+
+    Mesh-shape portability: the template's optimizer subtree MIRRORS the
+    checkpoint's, with ZeRO-1 flat vectors re-laid at the 1-process
+    serving extent — so the restore runs through the elastic
+    :class:`~tpu_dist.elastic.remap.Remapper` exactly like an elastic
+    resume (``docs/resilience.md``), and a checkpoint written at dp=8
+    loads bit-exactly. Serving then keeps params/bn/step ONLY; the
+    remapped optimizer state is dropped on the floor (it proved the
+    layout round-trips; inference has no use for momentum).
+
+    Returns ``{"params", "bn_state", "step", "epoch", "meta", "path",
+    "remapped"}`` (host numpy trees — the engine places them).
+    Raises when nothing in ``ckpt`` is usable."""
+    import os
+
+    import jax
+
+    from tpu_dist import ckpt as ckpt_lib
+    from tpu_dist.comm.quantize import padded_len
+    from tpu_dist.elastic import remap as remap_lib
+    from tpu_dist.train.state import TrainState
+
+    params, bn_state = model.init(jax.random.PRNGKey(key_seed))
+    L = remap_lib.params_len(params)
+
+    if os.path.isdir(ckpt):
+        candidates = ckpt_lib.all_checkpoints(ckpt)
+        if not candidates:
+            if ckpt_lib.latest_sharded_checkpoint(ckpt):
+                raise ValueError(
+                    f"{ckpt} holds sharded-format checkpoints; serving "
+                    "loads the plain format — write one with the plain "
+                    "saver (--sharded_ckpt off) or convert offline"
+                )
+            raise FileNotFoundError(f"no checkpoints in {ckpt}")
+    else:
+        candidates = [(ckpt, -1)]
+
+    last_err: Optional[Exception] = None
+    for path, epoch in candidates:
+        try:
+            meta = ckpt_lib.read_meta(path)
+            with np.load(path) as z:
+                opt_entries = {
+                    k[len("['opt_state']"):]: z[k]
+                    for k in z.files
+                    if k.startswith("['opt_state']")
+                }
+        except (ckpt_lib.CheckpointCorruptError,) + ckpt_lib.CKPT_READ_ERRORS as e:
+            last_err = e
+            if len(candidates) > 1:
+                ckpt_lib.quarantine(path)
+                continue
+            raise
+        el = (meta or {}).get("elastic") or {}
+        n_old = el.get("dp")
+        # mirror the checkpoint's optimizer subtree in the template, with
+        # dp-extent-dependent flat vectors RE-LAID at the serving extent
+        # (n=1): the restore then runs through the Remapper like any
+        # elastic resume, and its zero1_flat crop is the bit-exactness
+        # proof the round-trip test pins
+        opt_tpl = None
+        if opt_entries:
+            mirrored = {}
+            for k, arr in opt_entries.items():
+                if (
+                    arr.ndim == 1
+                    and isinstance(n_old, int) and n_old > 0
+                    and arr.size == padded_len(L, n_old)
+                ):
+                    mirrored[k] = np.zeros((padded_len(L, 1),), arr.dtype)
+                else:
+                    mirrored[k] = np.zeros(arr.shape, arr.dtype)
+            if "" in mirrored:  # the whole opt_state is ONE flat leaf
+                opt_tpl = mirrored[""] if len(mirrored) == 1 else None
+            else:
+                opt_tpl = _tree_from_keys(mirrored)
+        template = TrainState(
+            params=params,
+            bn_state=bn_state,
+            # an unparseable/absent opt subtree degrades to (): restore
+            # then ignores the checkpoint's opt entries (zero template
+            # leaves to fill) — serving only needs params/bn anyway
+            opt_state=opt_tpl if opt_tpl is not None else (),
+            step=np.zeros((), np.int32),
+        )
+        remapper = remap_lib.make_remapper(template, meta, 1)
+        try:
+            with spans_lib.span("serve/load_weights", file=os.path.basename(path)):
+                restored = ckpt_lib.restore(
+                    path, template, verify=verify, remap=remapper
+                )
+        except (ckpt_lib.CheckpointCorruptError,) + ckpt_lib.CKPT_READ_ERRORS as e:
+            last_err = e
+            if len(candidates) > 1:
+                ckpt_lib.quarantine(path)
+                continue
+            raise
+        counters_lib.inc("serve.weights_loaded")
+        if remapper.used:
+            counters_lib.inc("serve.weights_remapped")
+        return {
+            "params": restored.params,
+            "bn_state": restored.bn_state,
+            "step": int(np.asarray(restored.step)),
+            "epoch": meta.get("epoch", epoch),
+            "meta": meta,
+            "path": path,
+            "remapped": list(remapper.used),
+        }
+    raise ValueError(
+        f"every checkpoint candidate in {ckpt} was unreadable/corrupt "
+        f"(last error: {last_err})"
+    )
+
+
+# -- the engine --------------------------------------------------------------
+
+
+class ServingEngine:
+    """Continuous-batching inference over one jit-compiled forward step.
+
+    Single-threaded by design: callers :meth:`submit` requests (from a
+    socket loop, a replayed trace, a bench) and drive :meth:`pump`,
+    which assembles the longest-waiting requests into one bucket-padded
+    batch, dispatches the compiled step, and completes them with their
+    phase-split latencies recorded. :meth:`record_window` closes an
+    observation window: scalars → registry gauges + ``serve`` history
+    record (schema v10), SLO rules evaluated, exporter exposition
+    (histogram families included) refreshed.
+
+    ``clock`` is any ``() -> float`` monotonic source; the drill passes
+    a manual clock so the whole replay — queue waits included — is
+    deterministic. With a non-default clock the span timestamps live on
+    that clock too (only meaningful for offline analysis)."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        bn_state,
+        *,
+        max_batch: int = 8,
+        quantize: bool = False,
+        deadline_s: Optional[float] = None,
+        slo_rules: Optional[list] = None,
+        history=None,
+        exporter=None,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        self.model = model
+        self.buckets = batch_buckets(max_batch)
+        self.max_batch = max_batch
+        self._clock = clock or time.perf_counter
+        self._queue: collections.deque = collections.deque()
+        self.stats = slo_lib.ServeStats(deadline_s=deadline_s)
+        self.history = history
+        self.exporter = exporter
+        self._slo = (
+            slo_lib.make_slo_engine(slo_rules) if slo_rules else None
+        )
+        self._seq = 0
+        self._window_start = self._clock()
+        self._window_completed_at = 0  # stats.completed at window open
+        self._retraces_at_window = counters_lib.get("compile.retraces")
+        self.quantized = bool(quantize)
+        if quantize:
+            qtree, qshapes = quantize_weights(params)
+            self.params = jax.device_put(qtree)
+            self._qshapes = qshapes  # static closure, never traced
+
+            def forward(p, s, x):
+                logits, _ = model.apply(
+                    dequantize_weights(p, qshapes), s, x, train=False
+                )
+                return logits
+        else:
+            self.params = jax.device_put(
+                jax.tree_util.tree_map(jnp.asarray, params)
+            )
+
+            def forward(p, s, x):
+                logits, _ = model.apply(p, s, x, train=False)
+                return logits
+
+        self.bn_state = jax.device_put(
+            jax.tree_util.tree_map(jnp.asarray, bn_state)
+        )
+        # donate nothing: weights are long-lived serving state reused by
+        # every batch (tpu-dist: ignore[TD003] applies to TRAIN steps)
+        self._forward = jax.jit(forward)
+        self.watcher = costmodel_lib.CompileWatcher(
+            self._forward, name="serving forward step"
+        )
+        counters_lib.set_gauge("serve.max_batch", max_batch)
+        counters_lib.set_gauge(
+            "serve.quantized", "int8" if quantize else "none"
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def warmup(self, sample_shape: Tuple[int, ...], dtype="float32") -> int:
+        """Compile every bucket shape up front (zeros through the jitted
+        step, blocked) and BASELINE the compile watcher: these compiles
+        are expected; anything after is a mid-serve retrace. Returns the
+        number of executables compiled. ``sample_shape`` is ONE
+        request's payload shape (H, W, C).
+
+        The warmup batches are HOST numpy, exactly like the pump's
+        assembled batches — a committed device array here would warm a
+        different jit-cache signature and every first real batch per
+        bucket would retrace anyway."""
+        t0 = self._clock()
+        for b in self.buckets:
+            x = np.zeros((b,) + tuple(sample_shape), dtype)
+            self._forward(self.params, self.bn_state, x).block_until_ready()
+        self.watcher.baseline()
+        dur = self._clock() - t0
+        spans_lib.add_event("serve/warmup", t0, dur, buckets=len(self.buckets))
+        counters_lib.set_gauge("serve.warmup_s", round(dur, 3))
+        counters_lib.inc("serve.warmup_compiles", len(self.buckets))
+        return len(self.buckets)
+
+    # -- request flow -------------------------------------------------------
+
+    def submit(self, payload: np.ndarray, *, id=None,
+               arrival_s: Optional[float] = None) -> Request:
+        """Enqueue one request. ``arrival_s`` overrides the clock reading
+        (trace replay); ``payload`` is one sample (no batch dim)."""
+        self._seq += 1
+        req = Request(
+            id if id is not None else self._seq,
+            np.asarray(payload),
+            self._clock() if arrival_s is None else arrival_s,
+        )
+        self._queue.append(req)
+        self.stats.on_submit(len(self._queue))
+        counters_lib.inc("serve.requests")
+        return req
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def pump(self) -> List[Request]:
+        """Assemble and run ONE batch from the queue head (empty queue →
+        no-op). Returns the completed requests with results and phase
+        latencies filled in."""
+        if not self._queue:
+            return []
+        t_assemble = self._clock()
+        take = min(len(self._queue), self.max_batch)
+        reqs = [self._queue.popleft() for _ in range(take)]
+        bucket = bucket_for(take, self.buckets)
+        batch = np.zeros((bucket,) + reqs[0].payload.shape,
+                         reqs[0].payload.dtype)
+        for i, r in enumerate(reqs):
+            batch[i] = r.payload
+        self.stats.on_batch(take, bucket)
+        self.stats.set_queue_depth(len(self._queue))
+        counters_lib.inc("serve.batches")
+        counters_lib.inc("serve.batch_requests", take)
+
+        t_dispatch = self._clock()
+        out = self._forward(self.params, self.bn_state, batch)
+        t_dispatched = self._clock()
+        out.block_until_ready()
+        t_device = self._clock()
+        logits = np.asarray(out)
+        t_fetch = self._clock()
+
+        if self.watcher.observe(context="mid-serve (batch shape drift?)"):
+            # the watcher already counted + warned; stamp the serving-
+            # local event so the history/drill can pin WHICH batch
+            counters_lib.inc("serve.retraces")
+            if self.history is not None:
+                self.history.log(
+                    "serve", event="retrace", bucket=bucket, n_real=take,
+                )
+
+        # batch-grain spans (host timeline; Perfetto-ready when armed)
+        spans_lib.add_event("serve/batch_assembly", t_assemble,
+                            t_dispatch - t_assemble, n=take, bucket=bucket)
+        spans_lib.add_event("serve/dispatch", t_dispatch,
+                            t_dispatched - t_dispatch)
+        spans_lib.add_event("serve/device", t_dispatched,
+                            t_device - t_dispatched)
+        spans_lib.add_event("serve/fetch", t_device, t_fetch - t_device)
+
+        for i, r in enumerate(reqs):
+            r.result = logits[i]
+            r.ok = True
+            # a future-dated arrival (a replay that did not advance its
+            # clock first, or a frontend stamping arrivals from another
+            # clock origin) clamps to the assembly instant CONSISTENTLY:
+            # clamping only total/queue_wait would leave the positive
+            # batch phases summing past the total and break the
+            # phase-sums-≤-total invariant on a healthy engine
+            arrival = min(r.arrival_s, t_assemble)
+            r.phase_s = {
+                "queue_wait": t_assemble - arrival,
+                "batch_assembly": t_dispatch - t_assemble,
+                "dispatch": t_dispatched - t_dispatch,
+                "device": t_device - t_dispatched,
+                "fetch": t_fetch - t_device,
+            }
+            r.total_s = t_fetch - arrival
+            # TTFB: arrival → the device accepted the work (the dispatch
+            # returned and the result future exists) — the serving
+            # analogue of first-byte-queued, before the device/fetch tail
+            r.ttfb_s = t_dispatched - arrival
+            self.stats.on_request_done(r.total_s, r.ttfb_s, r.phase_s)
+        counters_lib.inc("serve.completed", take)
+        return reqs
+
+    def drain(self, max_pumps: int = 10_000) -> List[Request]:
+        """Pump until the queue empties; returns everything completed."""
+        done: List[Request] = []
+        for _ in range(max_pumps):
+            if not self._queue:
+                break
+            done.extend(self.pump())
+        return done
+
+    # -- observation windows -------------------------------------------------
+
+    def record_window(self) -> Dict[str, float]:
+        """Close one observation window: compute the ``serve.*`` scalars
+        (requests/s over THIS window), publish them as registry gauges,
+        evaluate the SLO rules, append a ``serve`` history record
+        (schema v10), and refresh the exporter's exposition — histogram
+        families included. Returns the scalar window."""
+        now = self._clock()
+        window_s = max(now - self._window_start, 1e-9)
+        completed = self.stats.completed - self._window_completed_at
+        scalars = self.stats.scalars(
+            window_s=window_s, completed_in_window=completed
+        )
+        self.stats.publish(scalars)
+        retraces = counters_lib.get("compile.retraces") - self._retraces_at_window
+        fired = []
+        if self._slo is not None:
+            window = dict(scalars)
+            window.update({
+                k: v for k, v in counters_lib.snapshot().items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            })
+            fired = self._slo.observe(window)
+            for alert in fired:
+                counters_lib.inc("serve.slo_alerts")
+                if self.history is not None:
+                    self.history.log("alert", **alert)
+        if self.history is not None:
+            rec = {
+                k.split("serve.", 1)[1]: v for k, v in scalars.items()
+            }
+            rec["window_s"] = round(window_s, 6)
+            if retraces:
+                rec["retraces"] = retraces
+            rec["phase_s"] = {
+                p: round(h.sum, 6) for p, h in self.stats.phases.items()
+            }
+            rec["latency_hist"] = self.stats.total.to_dict()
+            self.history.log("serve", **rec)
+        if self.exporter is not None:
+            labeled = (
+                {"alert_active": self._slo.active()}
+                if self._slo is not None else None
+            )
+            self.exporter.update(
+                counters_lib.snapshot(), labeled,
+                histograms=self.stats.histogram_families(), force=True,
+            )
+        self._window_start = now
+        self._window_completed_at = self.stats.completed
+        self._retraces_at_window = counters_lib.get("compile.retraces")
+        scalars["_fired"] = len(fired)
+        return scalars

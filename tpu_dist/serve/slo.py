@@ -1,0 +1,467 @@
+"""Serving SLO layer — streaming latency histograms, per-phase request
+stats, and declarative SLO rules (``docs/serving.md``).
+
+Everything here is **jax-free** on purpose: the stats are written from
+the engine's host-side pump loop and read back by the exporter's HTTP
+thread, the history writer, and offline tooling — none of which may
+touch a backend. The jaxpr-audit rule TD114 pins the other half of the
+contract: arming all of it leaves the traced forward step byte-identical
+to bare inference.
+
+Histograms: fixed **log-spaced buckets** (:data:`DEFAULT_EDGES`, 0.1 ms
+→ ~3.5 min in powers of two), NOT a sample list — ``observe`` is one
+bisect + increment, memory is O(buckets) however many requests flow
+through, and two histograms (different ranks, resumed segments) merge by
+elementwise addition. Quantiles come back as **upper bounds** (the upper
+edge of the bucket holding the q-th sample): a latency SLO wants the
+conservative direction, and the bound is at most one bucket (2×) off.
+The same bucket layout renders as an OpenMetrics ``histogram`` family
+(``_bucket{le=...}`` / ``_sum`` / ``_count`` — ``obs/export.py``), so a
+Prometheus scraping the run computes real ``histogram_quantile()``s.
+
+SLO rules are :class:`~tpu_dist.obs.alerts.AlertRule`\\s over the
+``serve.*`` metric namespace, evaluated per window by the PR 7
+:class:`~tpu_dist.obs.alerts.AlertEngine` (sustain / cooldown / delta
+semantics unchanged) — a breached p99 ceiling fires an ``alert`` history
+record and an ``alert_active`` exposition gauge exactly like a training
+stall does. ``--slo_rules default`` loads :data:`SLO_BUILTINS`; a
+``.toml``/``.json`` spec uses the ``[[rule]]`` grammar from
+``obs/alerts.py`` with the serve builtins available to ``builtin =``.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpu_dist.obs import alerts as alerts_lib
+from tpu_dist.obs import counters as counters_lib
+
+#: Fixed log-spaced bucket edges (seconds): 0.1 ms → ~209 s in powers of
+#: two. One shared layout so histograms merge across ranks/segments by
+#: construction; 22 buckets + overflow keeps a full phase set under 1 KB.
+DEFAULT_EDGES: Tuple[float, ...] = tuple(1e-4 * 2 ** i for i in range(22))
+
+#: Request phases, in pipeline order. ``queue_wait`` is per-request
+#: (arrival → its batch starts assembling); the rest are measured at
+#: batch grain and attributed to every request the batch carried.
+PHASES: Tuple[str, ...] = (
+    "queue_wait", "batch_assembly", "dispatch", "device", "fetch",
+)
+
+
+class LatencyHistogram:
+    """Streaming log-bucketed histogram: O(1) observe, O(buckets) memory,
+    mergeable, exact ``sum``/``count``/``min``/``max`` alongside the
+    bucketed distribution."""
+
+    __slots__ = ("edges", "counts", "sum", "count", "min", "max")
+
+    def __init__(self, edges: Sequence[float] = DEFAULT_EDGES):
+        if list(edges) != sorted(set(edges)):
+            raise ValueError("histogram edges must be strictly increasing")
+        self.edges: Tuple[float, ...] = tuple(float(e) for e in edges)
+        self.counts: List[int] = [0] * (len(self.edges) + 1)  # + overflow
+        self.sum = 0.0
+        self.count = 0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, seconds: float) -> None:
+        v = float(seconds)
+        # OpenMetrics bucket semantics: bucket le=edge counts v <= edge
+        self.counts[bisect.bisect_left(self.edges, v)] += 1
+        self.sum += v
+        self.count += 1
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+    def merge(self, other: "LatencyHistogram") -> None:
+        """Fold ``other`` in (cross-rank / cross-segment aggregation).
+        Refuses mismatched bucket layouts — a silent re-bucketing would
+        fabricate a distribution."""
+        if other.edges != self.edges:
+            raise ValueError(
+                f"cannot merge histograms with different bucket layouts "
+                f"({len(other.edges)} vs {len(self.edges)} edges)"
+            )
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.sum += other.sum
+        self.count += other.count
+        for attr, pick in (("min", min), ("max", max)):
+            o = getattr(other, attr)
+            if o is not None:
+                s = getattr(self, attr)
+                setattr(self, attr, o if s is None else pick(s, o))
+
+    def quantile_bound(self, q: float) -> Optional[float]:
+        """Upper bound on the q-quantile: the upper edge of the bucket
+        holding the ⌈q·count⌉-th sample (the exact ``max`` for the
+        overflow bucket). None while empty. Conservative by design —
+        an SLO ceiling compared against this can under-alarm by at most
+        one bucket width, never over-report a healthy run."""
+        if not self.count:
+            return None
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        target = max(1, -(-int(self.count * q * 1e9) // int(1e9)))  # ceil
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= target:
+                return self.edges[i] if i < len(self.edges) else self.max
+        return self.max  # unreachable with consistent counts
+
+    # -- serialization ------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        """Compact history-record form (non-zero buckets only — a quiet
+        phase costs a few bytes per record, not 23 zeros)."""
+        return {
+            "edges": len(self.edges),
+            "buckets": {
+                str(i): c for i, c in enumerate(self.counts) if c
+            },
+            "sum": round(self.sum, 9),
+            "count": self.count,
+            "min": self.min,
+            "max": self.max,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict, edges: Sequence[float] = DEFAULT_EDGES) -> "LatencyHistogram":
+        if int(d.get("edges", len(DEFAULT_EDGES))) != len(edges):
+            raise ValueError(
+                f"serialized histogram has {d.get('edges')} edges, "
+                f"reader expects {len(edges)}"
+            )
+        h = cls(edges)
+        for i, c in (d.get("buckets") or {}).items():
+            i = int(i)
+            if not 0 <= i < len(h.counts):
+                # a corrupt/foreign record must not write past the bucket
+                # array — or silently into the overflow bucket via a
+                # negative index, fabricating a distribution
+                raise ValueError(
+                    f"serialized histogram bucket index {i} out of range "
+                    f"(0..{len(h.counts) - 1})"
+                )
+            h.counts[i] = int(c)
+        h.sum = float(d.get("sum", 0.0))
+        h.count = int(d.get("count", 0))
+        h.min = d.get("min")
+        h.max = d.get("max")
+        return h
+
+    def to_openmetrics(self) -> dict:
+        """The shape ``export.render(histograms=...)`` consumes:
+        cumulative ``(le, count)`` pairs (ending with ``+Inf``) plus
+        ``sum``/``count`` — the OpenMetrics ``histogram`` family."""
+        buckets = []
+        cum = 0
+        for edge, c in zip(self.edges, self.counts):
+            cum += c
+            buckets.append((format(edge, ".6g"), cum))
+        buckets.append(("+Inf", self.count))
+        return {"buckets": buckets, "sum": self.sum, "count": self.count}
+
+
+class ServeStats:
+    """The engine's per-process serving stats: one total-latency and one
+    TTFB histogram, one histogram per phase, queue/batch gauges, and the
+    availability ledger. Host arithmetic only — the pump loop writes it,
+    :meth:`publish` mirrors the scalars into the counter/gauge registry
+    so history records and OpenMetrics expositions carry them for free.
+
+    ``deadline_s`` arms goodput-style availability: a request is GOOD
+    when its total latency meets the deadline; ``availability`` is
+    good/completed. Without a deadline every completed request is good
+    (availability measures completion only).
+    """
+
+    def __init__(self, deadline_s: Optional[float] = None,
+                 edges: Sequence[float] = DEFAULT_EDGES):
+        self.deadline_s = deadline_s
+        self.total = LatencyHistogram(edges)
+        self.ttfb = LatencyHistogram(edges)
+        self.phases: Dict[str, LatencyHistogram] = {
+            p: LatencyHistogram(edges) for p in PHASES
+        }
+        self.submitted = 0
+        self.completed = 0
+        self.good = 0          # met the deadline (or all, without one)
+        self.batches = 0
+        self.padded_slots = 0  # bucket slots carrying padding, summed
+        self.occupancy_sum = 0.0  # Σ real/bucket per batch
+        self.queue_depth = 0
+        self.queue_depth_max = 0
+
+    # -- writes (engine pump loop) ------------------------------------------
+
+    def on_submit(self, depth: int) -> None:
+        self.submitted += 1
+        self.set_queue_depth(depth)
+
+    def set_queue_depth(self, depth: int) -> None:
+        self.queue_depth = depth
+        self.queue_depth_max = max(self.queue_depth_max, depth)
+
+    def on_batch(self, n_real: int, bucket: int) -> None:
+        self.batches += 1
+        self.padded_slots += bucket - n_real
+        self.occupancy_sum += n_real / bucket
+
+    def on_request_done(
+        self, total_s: float, ttfb_s: float, phase_s: Dict[str, float]
+    ) -> None:
+        self.total.observe(total_s)
+        self.ttfb.observe(ttfb_s)
+        for p in PHASES:
+            self.phases[p].observe(phase_s.get(p, 0.0))
+        self.completed += 1
+        if self.deadline_s is None or total_s <= self.deadline_s:
+            self.good += 1
+
+    # -- reads --------------------------------------------------------------
+
+    def batch_occupancy(self) -> Optional[float]:
+        return self.occupancy_sum / self.batches if self.batches else None
+
+    def availability(self) -> Optional[float]:
+        return self.good / self.completed if self.completed else None
+
+    def scalars(self, window_s: Optional[float] = None,
+                completed_in_window: Optional[int] = None) -> Dict[str, float]:
+        """One flat ``serve.*`` metrics window — what the SLO alert
+        engine observes and :meth:`publish` mirrors into the registry.
+        Quantiles are :meth:`LatencyHistogram.quantile_bound` upper
+        bounds in milliseconds."""
+        out: Dict[str, float] = {
+            "serve.requests": self.submitted,
+            "serve.completed": self.completed,
+            "serve.batches": self.batches,
+            "serve.queue_depth": self.queue_depth,
+            "serve.queue_depth_max": self.queue_depth_max,
+        }
+
+        def put(name, v, scale=1.0, digits=6):
+            if isinstance(v, (int, float)):
+                out[name] = round(v * scale, digits)
+
+        put("serve.latency_p50_ms", self.total.quantile_bound(0.5), 1e3)
+        put("serve.latency_p95_ms", self.total.quantile_bound(0.95), 1e3)
+        put("serve.latency_p99_ms", self.total.quantile_bound(0.99), 1e3)
+        put("serve.ttfb_p50_ms", self.ttfb.quantile_bound(0.5), 1e3)
+        put("serve.ttfb_p99_ms", self.ttfb.quantile_bound(0.99), 1e3)
+        put("serve.availability", self.availability())
+        put("serve.batch_occupancy", self.batch_occupancy())
+        if window_s and window_s > 0 and completed_in_window is not None:
+            put("serve.requests_per_s", completed_in_window / window_s, 1.0, 3)
+        return out
+
+    def publish(self, scalars: Optional[Dict[str, float]] = None) -> Dict[str, float]:
+        """Mirror the scalar view into the process-global registry: every
+        later history record and OpenMetrics exposition carries the
+        ``serve.*`` gauges with no per-metric plumbing."""
+        scalars = scalars if scalars is not None else self.scalars()
+        for name, v in scalars.items():
+            counters_lib.set_gauge(name, v)
+        return scalars
+
+    def histogram_families(self) -> Dict[str, dict]:
+        """The exposition histogram families (total + TTFB + per-phase),
+        keyed by raw registry-style names — feed straight into
+        ``export.render(histograms=...)``."""
+        fams = {
+            "serve.latency_seconds": self.total.to_openmetrics(),
+            "serve.ttfb_seconds": self.ttfb.to_openmetrics(),
+        }
+        for p, h in self.phases.items():
+            fams[f"serve.phase_{p}_seconds"] = h.to_openmetrics()
+        return fams
+
+    def check_invariants(self) -> List[str]:
+        """The drill/test invariants; returns the violations (empty =
+        healthy). (1) sum-to-count: every histogram's bucket counts sum
+        to its ``count``, and every phase (and TTFB) saw exactly as many
+        samples as the total. (2) phase latencies account for at most
+        the total latency (queue→fetch partitions the request's life;
+        float addition slack only)."""
+        probs: List[str] = []
+        for name, h in (
+            [("total", self.total), ("ttfb", self.ttfb)]
+            + list(self.phases.items())
+        ):
+            if sum(h.counts) != h.count:
+                probs.append(
+                    f"{name}: bucket counts sum to {sum(h.counts)}, "
+                    f"count says {h.count}"
+                )
+            if h.count != self.total.count:
+                probs.append(
+                    f"{name}: {h.count} sample(s) vs {self.total.count} "
+                    "completed requests"
+                )
+        if self.total.count != self.completed:
+            probs.append(
+                f"total histogram holds {self.total.count} sample(s), "
+                f"{self.completed} requests completed"
+            )
+        phase_sum = sum(h.sum for h in self.phases.values())
+        if phase_sum > self.total.sum + 1e-6 * max(1.0, self.total.sum):
+            probs.append(
+                f"phase latency sum {phase_sum:.6f}s exceeds total "
+                f"latency sum {self.total.sum:.6f}s"
+            )
+        return probs
+
+
+# -- SLO rules ---------------------------------------------------------------
+
+#: The built-in serving SLO library (``--slo_rules default``): ceilings a
+#: production endpoint wants armed. Thresholds are deliberately loose —
+#: a real deployment overrides them from a spec; the POINT is that a
+#: breach fires through the same alert engine / history / exposition
+#: path a training stall does.
+SLO_BUILTINS: Dict[str, alerts_lib.AlertRule] = {
+    r.name: r
+    for r in (
+        alerts_lib.AlertRule("slo_p99_high", "serve.latency_p99_ms", ">",
+                             500.0, sustain=2, cooldown=3),
+        alerts_lib.AlertRule("slo_p50_high", "serve.latency_p50_ms", ">",
+                             100.0, sustain=2, cooldown=3),
+        alerts_lib.AlertRule("slo_ttfb_high", "serve.ttfb_p99_ms", ">",
+                             250.0, sustain=2, cooldown=3),
+        alerts_lib.AlertRule("slo_availability_low", "serve.availability",
+                             "<", 0.999, sustain=1, cooldown=3),
+        alerts_lib.AlertRule("slo_rps_low", "serve.requests_per_s", "<",
+                             1.0, sustain=2, cooldown=3),
+        alerts_lib.AlertRule("slo_queue_deep", "serve.queue_depth", ">",
+                             64.0, sustain=2, cooldown=3),
+        # a mid-serve retrace is a full XLA compile stall on the serving
+        # path: ANY growth of the watcher's counter is alertable
+        alerts_lib.AlertRule("serve_retrace", "compile.retraces", ">",
+                             0.0, sustain=1, cooldown=1, delta=True),
+    )
+}
+
+
+def load_slo_rules(spec: str) -> List[alerts_lib.AlertRule]:
+    """``--slo_rules`` → validated rule list. ``default`` loads
+    :data:`SLO_BUILTINS`; otherwise the value is a ``.toml``/``.json``
+    path in the ``[[rule]]`` grammar of ``obs/alerts.py``, with both the
+    training and serving builtin libraries available to ``builtin =``."""
+    if spec in ("default", "builtin"):
+        return list(SLO_BUILTINS.values())
+    return alerts_lib.load_rules(
+        spec, builtins={**alerts_lib.BUILTIN_RULES, **SLO_BUILTINS}
+    )
+
+
+def make_slo_engine(rules: List[alerts_lib.AlertRule]) -> alerts_lib.AlertEngine:
+    """The PR 7 alert engine over the serve windows; delta rules seeded
+    immediately (a serving process has no fit() start to seed from)."""
+    eng = alerts_lib.AlertEngine(rules)
+    eng.seed_deltas(counters_lib.snapshot())
+    return eng
+
+
+# -- offline serve report (``python -m tpu_dist.serve report``) --------------
+
+
+def serve_report(records: List[dict]) -> dict:
+    """Fold a history JSONL's ``serve`` records (schema v10) into one
+    report: the window table, last-window scalars, and the alerts that
+    fired on serve metrics. Jax-free file crunching."""
+    windows = [
+        r for r in records
+        if r.get("kind") == "serve" and not r.get("event")
+    ]
+    alerts = [
+        r for r in records
+        if r.get("kind") == "alert"
+        and str(r.get("metric", "")).startswith("serve.")
+    ]
+    last = windows[-1] if windows else {}
+    total = LatencyHistogram()
+    for w in windows:
+        h = w.get("latency_hist")
+        if isinstance(h, dict):
+            try:
+                # windows carry CUMULATIVE histograms: the last parseable
+                # one IS the run's distribution (no merge — merging
+                # cumulative snapshots would multiply-count)
+                total = LatencyHistogram.from_dict(h)
+            except (ValueError, TypeError, KeyError):
+                continue
+    return {
+        "n_windows": len(windows),
+        "windows": windows,
+        "alerts": alerts,
+        "last": {
+            k: last.get(k)
+            for k in ("requests", "completed", "requests_per_s",
+                      "latency_p50_ms", "latency_p95_ms", "latency_p99_ms",
+                      "ttfb_p50_ms", "ttfb_p99_ms", "availability",
+                      "batch_occupancy", "queue_depth_max", "retraces")
+            if last.get(k) is not None
+        },
+        "latency_hist": total.to_dict() if total.count else None,
+    }
+
+
+def window_table_lines(windows: List[dict]) -> List[str]:
+    """The serve-window table (header + one row per window + retrace
+    warning sublines) — ONE renderer shared by the offline serve report
+    (:func:`format_report_text`) and ``obs summarize``, so the two
+    views can never drift column by column (the
+    ``postmortem.rank_summary`` discipline)."""
+    lines = [
+        f"{'window':>7} {'req/s':>8} {'p50_ms':>8} {'p99_ms':>8} "
+        f"{'ttfb99':>8} {'avail':>7} {'occup':>6} {'queue':>6} {'compl':>6}"
+    ]
+
+    def fmt(v, spec, width):
+        return (format(v, spec) if isinstance(v, (int, float)) else "-").rjust(width)
+
+    for i, w in enumerate(windows):
+        lines.append(
+            f"{i:>7} {fmt(w.get('requests_per_s'), '.1f', 8)} "
+            f"{fmt(w.get('latency_p50_ms'), '.2f', 8)} "
+            f"{fmt(w.get('latency_p99_ms'), '.2f', 8)} "
+            f"{fmt(w.get('ttfb_p99_ms'), '.2f', 8)} "
+            f"{fmt(w.get('availability'), '.3f', 7)} "
+            f"{fmt(w.get('batch_occupancy'), '.2f', 6)} "
+            f"{fmt(w.get('queue_depth_max'), 'd', 6)} "
+            f"{fmt(w.get('completed'), 'd', 6)}"
+        )
+        if w.get("retraces"):
+            lines.append(
+                f"      WARNING: {w['retraces']:g} mid-serve retrace(s) "
+                "— a batch escaped the bucket ladder"
+            )
+    return lines
+
+
+def format_report_text(report: dict) -> str:
+    lines = [
+        f"serve report — {report['n_windows']} window(s), "
+        f"{len(report['alerts'])} SLO alert(s)"
+    ]
+    if not report["n_windows"]:
+        return lines[0] + " (no serve records — not a serving log?)"
+    lines.extend(window_table_lines(report["windows"]))
+    for a in report["alerts"]:
+        lines.append(
+            f"  SLO ALERT {a.get('rule')}: {a.get('metric')} "
+            f"{a.get('value')} {a.get('op')} {a.get('threshold')} "
+            f"(sustained {a.get('sustained')} window(s))"
+        )
+    last = report.get("last") or {}
+    if last:
+        lines.append(
+            "final: "
+            + ", ".join(f"{k}={v}" for k, v in sorted(last.items()))
+        )
+    return "\n".join(lines)

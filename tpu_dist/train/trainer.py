@@ -1351,16 +1351,12 @@ class Trainer:
                 # just donated to the step.
                 self._capture_step_cost(new_state, images, labels, lr)
             self._step_traced = True
-            if self._compile_watch.observe():
+            if self._compile_watch.observe(context=f"epoch {epoch} step {step}"):
                 # the executable cache grew after the first trace: a mid-run
                 # retrace (shape/dtype drift) — a full XLA compile stall on
-                # every host; compile.retraces counted by the watcher and
-                # surfaced per-epoch by `obs summarize`
-                rank0_print(
-                    f"WARNING: train step RECOMPILED at epoch {epoch} step "
-                    f"{step} — input shape/dtype drift? (compile.retraces="
-                    f"{counters_lib.get('compile.retraces'):g})"
-                )
+                # every host; counter + rank-0 warning live in the watcher
+                # itself (the serving engine shares them), surfaced
+                # per-epoch by `obs summarize`
                 if (
                     self._profiler is not None
                     and "retrace" in self._profile_triggers
