@@ -1,6 +1,6 @@
 # Convenience targets; CI / the driver call the underlying commands directly.
 
-.PHONY: test quick bench csrc clean lint pod-report monitor profile-report elastic-drill fleet-drill postmortem-drill serve-drill serve-report
+.PHONY: test quick bench csrc clean lint pod-report monitor profile-report elastic-drill fleet-drill postmortem-drill serve-drill serve-report memory-report
 
 csrc:
 	$(MAKE) -C tpu_dist/csrc
@@ -78,6 +78,14 @@ serve-drill:
 # availability, occupancy, fired SLO alerts)
 serve-report:
 	python -m tpu_dist.serve report $(LOG)
+
+# Offline HBM report over a run's memory records + mem.* gauge series:
+#   make memory-report LOG=run.jsonl
+# (docs/observability.md "HBM ledger & OOM forensics" — the per-leaf
+# static ledger, the memory_analysis waterfall, the census/allocator
+# reconciliation, OOM events, and the peak-HBM compare-gate scalar)
+memory-report:
+	python -m tpu_dist.obs memory $(LOG)
 
 # Follow a LIVE run from another terminal:
 #   make monitor LOG=run.jsonl [HB=hb.json]

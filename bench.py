@@ -932,16 +932,24 @@ def main() -> None:
             ("grad accumulation ×4", "resnet18_cifar100_ga4"),
             ("fused epoch (device-resident)", "resnet18_cifar100_fused"),
         ]
-        print("| mode | sec/epoch | images/sec | MFU | goodput | vs 4x2080Ti DDP+apex |")
-        print("|---|---|---|---|---|---|")
+        from tpu_dist.obs.memory import fmt_bytes
+
+        print("| mode | sec/epoch | images/sec | MFU | goodput | peak HBM "
+              "| vs 4x2080Ti DDP+apex |")
+        print("|---|---|---|---|---|---|---|")
         for label, name in rows:
             out = run(CONFIGS[name], args.steps, args.warmup)
             mfu = out.get("mfu")
             gp = out.get("goodput_frac")
+            # XLA's static per-executable accounting (memory_analysis) —
+            # already in every bench record; CPU-valid, so the memory
+            # column gates even while the TPU tunnel is down
+            hbm = out.get("peak_hbm_bytes")
             print(
                 f"| {label} | {out['sec_per_epoch']} | {out['value']} "
                 f"| {f'{mfu:.1%}' if mfu is not None else 'n/a'} "
                 f"| {f'{gp:.1%}' if gp is not None else 'n/a'} "
+                f"| {fmt_bytes(hbm) if hbm is not None else 'n/a'} "
                 f"| {out['vs_baseline']}x |"
             )
         return

@@ -413,8 +413,9 @@ def test_compare_missing_metrics_reported_skipped_not_dropped(tmp_path):
     result = cmp.compare_files(base, cand)  # no mfu/eval/goodput/capture
     skipped = {r["metric"] for r in result["rows"] if r["verdict"] == "skipped"}
     assert skipped == {"mfu_mean", "final_val_top1", "goodput_frac",
-                       "overlap_frac", "collective_frac"}
-    assert result["skipped"] == 5
+                       "overlap_frac", "collective_frac",
+                       "peak_hbm_bytes"}
+    assert result["skipped"] == 6
 
 
 def test_compare_bench_mode_matches_by_metric_name(tmp_path):

@@ -1348,6 +1348,120 @@ def serving_slo_noop_violations(mesh=None) -> list[Violation]:
     return out
 
 
+#: A canned TPU-style RESOURCE_EXHAUSTED text the TD115 probe parses —
+#: arming the OOM parser is part of the memory kit under audit.
+_TD115_OOM_TEXT = (
+    "RESOURCE_EXHAUSTED: Ran out of memory in memory space hbm. "
+    "Used 15.90G of 15.48G hbm. Exceeded hbm capacity by 430.5M.\n"
+    "Largest program allocations in hbm:\n"
+    "  1. Size: 2.50G\n"
+    '     Operator: op_name="jit(train_step)/dot_general"\n'
+    "     Shape: f32[8192,81920]\n"
+)
+
+
+def memory_ledger_noop_violations(mesh=None) -> list[Violation]:
+    """TD115: the HBM-observability cost contract, checked at the
+    program level (the TD105-TD114 armed-vs-off discipline applied to
+    ``obs/memory.py``) — trace the data-parallel step with nothing
+    armed, then arm the FULL memory kit exactly as the trainer does:
+    the static per-leaf ledger over a real ZeRO-1-sharded state
+    (sharded-extent accounting from shardings), the live-buffer census
+    over ``jax.live_arrays()``, the allocator ``memory_stats()`` read,
+    the census/allocator reconciliation, the ``mem.*`` gauges
+    published, the pre-flight feasibility check priced against a real
+    budget, the ``memory_analysis()`` waterfall of an AOT-compiled
+    probe, and the RESOURCE_EXHAUSTED parser over a canned TPU OOM
+    text — and trace again. The two jaxprs must be byte-identical:
+    the whole ledger is shape/sharding metadata arithmetic, and the
+    moment someone routes a byte-counting probe or a 'helpful' sync
+    through the traced step, this trips. The probe also asserts the
+    kit actually RAN (non-empty ledger, the reconciliation identity
+    holding exactly, a parsed OOM report with the right byte counts) —
+    a dead ledger would make the comparison vacuous."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.obs import costmodel
+    from tpu_dist.obs import memory as memory_lib
+
+    m = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+    # ZeRO-1 case: the state's flat momentum is genuinely sharded, so
+    # the ledger's sharded-extent accounting is exercised, not skipped
+    fn, args = _dp_setup(m, shard_weight_update=True)
+    state = args[0]
+    base = str(jax.make_jaxpr(fn)(*args))
+
+    led = memory_lib.static_ledger(
+        params=state.params, opt_state=state.opt_state, ef=state.ef,
+        bn_state=state.bn_state,
+    )
+    census = memory_lib.live_census()
+    rec = memory_lib.reconcile(census, costmodel.device_memory_stats())
+    memory_lib.publish_ledger({
+        "static": led, "census": census, "reconciliation": rec,
+    })
+    feas = memory_lib.feasibility(
+        led["bytes_per_device"], budget_bytes=16 * 1024 ** 3, headroom=0.9,
+    )
+    probe = jax.jit(lambda x: x * 2.0)
+    xla = costmodel.memory_analysis_jitted(probe, jnp.ones((64,)))
+    oom = memory_lib.parse_resource_exhausted(_TD115_OOM_TEXT)
+
+    fn2, args2 = _dp_setup(m, shard_weight_update=True)
+    armed = str(jax.make_jaxpr(fn2)(*args2))
+
+    n = m.devices.size
+    ran = (
+        led["bytes_per_device"] > 0
+        and led["sections"]["opt_state"]["bytes_per_device"] > 0
+        and (
+            n == 1
+            or led["sections"]["opt_state"]["sharded_leaves"] > 0
+        )
+        and census["n_arrays"] > 0
+        and rec["attributed_bytes"] + rec["unattributed_bytes"]
+        == rec["bytes_in_use"]
+        and feas["fits"]
+        and oom is not None
+        and oom.get("used_bytes") == int(15.90 * 1024 ** 3)
+        and len(oom.get("buffers") or []) == 1
+    )
+    out: list[Violation] = []
+    if not ran:
+        out.append(
+            Violation(
+                "TD115",
+                "<jaxpr:dp_memory_ledger_noop>",
+                0,
+                "the TD115 probe armed the HBM ledger kit but it did "
+                "not actually run (empty ledger, no sharded-extent "
+                "accounting, broken reconciliation identity, or the "
+                "OOM parser returned garbage) — the armed-vs-off "
+                "comparison would be vacuous (obs/memory.py contract)",
+                snippet="memory ledger probe did not fire",
+            )
+        )
+    if base != armed:
+        out.append(
+            Violation(
+                "TD115",
+                "<jaxpr:dp_memory_ledger_noop>",
+                0,
+                "the traced train step CHANGED when the HBM ledger was "
+                "armed (static per-leaf accounting, live census, "
+                "allocator reconciliation, gauges, feasibility check, "
+                "memory_analysis waterfall, OOM parser) — memory "
+                "observability must stay host-side metadata arithmetic "
+                "(obs/memory.py contract, docs/observability.md "
+                "'HBM ledger & OOM forensics')",
+                snippet="jaxpr(ledger_off) != jaxpr(ledger_armed)",
+            )
+        )
+    return out
+
+
 def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     """Run every (or the named) registered case. Returns
     ``(report, violations)`` where report maps case → op counts.
@@ -1356,7 +1470,8 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     the TD105 fault-injection, TD106 telemetry, TD107 device-metrics,
     TD108 profiler-trigger, TD109 live-export/alerting, TD110
     capture-auto-analyze, TD111 elastic-resume, TD112 elastic-grow,
-    TD113 flight-recorder, and TD114 serving-SLO no-op invariants."""
+    TD113 flight-recorder, TD114 serving-SLO, and TD115 memory-ledger
+    no-op invariants."""
     report: dict = {}
     violations: list[Violation] = []
     for name in names if names is not None else registered_cases():
@@ -1394,6 +1509,9 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
         violations.extend(vs)
         vs = serving_slo_noop_violations(mesh)
         report["serving_slo_noop"] = {"identical": not vs}
+        violations.extend(vs)
+        vs = memory_ledger_noop_violations(mesh)
+        report["dp_memory_ledger_noop"] = {"identical": not vs}
         violations.extend(vs)
     return report, violations
 

@@ -202,6 +202,19 @@ RULES: dict[str, Rule] = {
             "(tpu_dist/serve contract, docs/serving.md)",
         ),
         Rule(
+            "TD115",
+            "memory-ledger-not-noop",
+            "the traced train step differs between the HBM ledger OFF "
+            "and the full memory kit armed (static per-leaf ledger over "
+            "a real sharded state, live-buffer census, allocator stats "
+            "read, census/allocator reconciliation, mem.* gauges "
+            "published, pre-flight feasibility check, memory_analysis "
+            "waterfall of an AOT probe, RESOURCE_EXHAUSTED parser "
+            "exercised) — memory observability must stay host-side "
+            "metadata arithmetic (obs/memory.py contract, "
+            "docs/observability.md 'HBM ledger & OOM forensics')",
+        ),
+        Rule(
             "TD104",
             "quantized-wire-bytes-over-budget",
             "gradient-collective payload bytes of a quantized wire format "
@@ -290,11 +303,14 @@ RANK_CALL_SUFFIXES = ("process_index", "is_primary", "get_rank")
 RANK_VAR_NAMES = {"rank", "local_rank", "process_id", "proc_id", "process_index", "pid"}
 
 # Modules exempt from TD002: host-side tooling that never runs inside a
-# multi-process training job (the analysis and obs CLIs' report output,
-# the fleet controller — the scheduler/drill/capacity census run in
-# the single arbiter/launcher process, whose FILES are the control
-# channel the runs' probes read — and the serve CLI/drill, which run in
-# the single serving/operator process).
+# multi-process training job (the analysis and obs CLIs' report output —
+# `obs memory`'s ledger/OOM reports included, the fleet controller — the
+# scheduler/drill/capacity census run in the single arbiter/launcher
+# process, whose FILES are the control channel the runs' probes read —
+# and the serve CLI/drill, which run in the single serving/operator
+# process). obs/memory.py itself is NOT exempt: its in-job artifact
+# writes (oom.json) carry inline ignores with the per-rank-path
+# justification instead.
 TD002_EXEMPT_PARTS = (
     "tpu_dist/analysis/", "tpu_dist/obs/__main__.py", "tpu_dist/fleet/",
     "tpu_dist/serve/__main__.py", "tpu_dist/serve/drill.py",
@@ -302,9 +318,11 @@ TD002_EXEMPT_PARTS = (
 
 # TD007 allowlist: the designated output layer (rank0_print/get_logger and
 # the ProgressMeter display sink, which carries the rank-0 guard itself)
-# plus pure-CLI report modules whose stdout IS the product. Everything
-# else must route prints through the logging layer — the statically-
-# enforced version of the rank-0 discipline the reference only documents.
+# plus pure-CLI report modules whose stdout IS the product — the `obs`
+# subcommands (summarize/compare/pod/xprof/postmortem/memory) all print
+# through obs/__main__.py. Everything else must route prints through the
+# logging layer — the statically-enforced version of the rank-0
+# discipline the reference only documents.
 TD007_ALLOWED_PARTS = (
     "tpu_dist/metrics/logging.py",
     "tpu_dist/metrics/meters.py",

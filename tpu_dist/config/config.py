@@ -155,6 +155,26 @@ class TrainConfig:
                                    # tracebacks, SIGUSR1 on-demand
                                    # all-threads dumps); read back by
                                    # `python -m tpu_dist.obs postmortem`
+    memory_check: str = "warn"     # off | warn | refuse — pre-flight HBM
+                                   # feasibility lint (obs/memory.py):
+                                   # the static per-leaf ledger (params/
+                                   # opt-state/EF/BN/batch at sharded
+                                   # extents) is priced against the
+                                   # per-chip HBM budget BEFORE the
+                                   # first compile; 'refuse' raises
+                                   # InfeasibleMemoryError, 'warn'
+                                   # prints. Unknown chips (CPU
+                                   # emulation) skip the check unless
+                                   # hbm_budget_bytes overrides
+    memory_headroom: float = 0.9   # fraction of the per-chip budget the
+                                   # STATIC estimate may claim — the
+                                   # rest is reserved for XLA temps/
+                                   # workspace the ledger cannot see
+    hbm_budget_bytes: Optional[int] = None  # per-device HBM budget
+                                   # override (default: the chip table,
+                                   # costmodel.CHIP_HBM_BYTES); lets CPU
+                                   # tests and exotic parts drive the
+                                   # feasibility lint
     per_host_log: bool = False     # every process writes its own JSONL
                                    # history (<log_file>.h<rank>; rank 0
                                    # keeps the bare path) so `obs pod`
@@ -460,6 +480,23 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "demand, the launcher watchdog's stack-capture "
                         "channel). Assemble with `python -m tpu_dist.obs "
                         "postmortem <dir>` (docs/observability.md)")
+    p.add_argument("--memory_check", type=str, default=d.memory_check,
+                   choices=("off", "warn", "refuse"),
+                   help="pre-flight HBM feasibility lint: price the "
+                        "static per-leaf memory ledger (params/opt-state/"
+                        "EF/BN/batch, sharded extents) against the "
+                        "per-chip HBM budget BEFORE the first compile; "
+                        "'refuse' stops an infeasible config, 'warn' "
+                        "prints (docs/observability.md)")
+    p.add_argument("--memory_headroom", type=float,
+                   default=d.memory_headroom, metavar="FRAC",
+                   help="fraction of the per-chip HBM budget the static "
+                        "estimate may claim (rest reserved for XLA "
+                        "temps/workspace)")
+    p.add_argument("--hbm_budget_bytes", type=int, default=None,
+                   help="per-device HBM budget override in bytes "
+                        "(default: the chip table — "
+                        "obs/costmodel.CHIP_HBM_BYTES)")
     p.add_argument("--per_host_log", action="store_true",
                    help="every process writes its own JSONL history "
                         "(<log_file>.h<rank>; rank 0 keeps the bare path) "

@@ -38,7 +38,14 @@ watchdog's auto-invoke rather than by the dying run itself); v10 added
 the serving layer — the ``serve`` kind (one SLO observation window per
 record: latency percentile bounds, requests/s, availability, batch
 occupancy, per-phase latency sums, a compact latency histogram —
-``tpu_dist/serve``, docs/serving.md)
+``tpu_dist/serve``, docs/serving.md); v11 added the memory layer — the
+``memory`` kind (the HBM ledger captured at first dispatch: static
+per-leaf accounting from avals+shardings, the ``memory_analysis()``
+waterfall, a live-buffer census reconciled against the allocator so
+attributed + unattributed == bytes_in_use exactly; ``event: "oom"``
+records carry a parsed RESOURCE_EXHAUSTED report plus the ledger
+snapshot live at the crash — ``obs/memory.py``, docs/observability.md
+"HBM ledger & OOM forensics")
 (docs/observability.md). Consumers (``obs summarize``/``compare``) read
 all versions: every addition is a new kind or optional field, never a
 changed one, and readers skip-with-count kinds they don't know — so a
@@ -61,13 +68,13 @@ import jax
 
 from tpu_dist.obs import counters as counters_lib
 
-SCHEMA_VERSION = 10  # v10 (additive): 'serve' serving-SLO window records
-#                      (latency percentile bounds, requests/s,
-#                      availability, batch occupancy, phase sums, compact
-#                      latency histogram — tpu_dist/serve/engine.py,
-#                      docs/serving.md); v9 added 'postmortem'
-#                      crash-bundle records; v8 'fleet' scheduler
-#                      decisions; v7 'resume' segment boundaries
+SCHEMA_VERSION = 11  # v11 (additive): 'memory' HBM-ledger records (static
+#                      per-leaf accounting, memory_analysis waterfall,
+#                      census/allocator reconciliation, OOM events —
+#                      tpu_dist/obs/memory.py); v10 added 'serve'
+#                      serving-SLO windows; v9 'postmortem' crash
+#                      bundles; v8 'fleet' scheduler decisions; v7
+#                      'resume' segment boundaries
 
 
 class MetricsHistory:

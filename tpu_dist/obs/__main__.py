@@ -46,6 +46,17 @@ Subcommands::
         Accepts a capture directory (``plugins/profile/...`` inside —
         multi-host trees included) or one Chrome trace file.
 
+    memory <run.jsonl> [--format text|json]
+    memory --oom <traceback.txt> [--format text|json]
+        HBM report (``obs/memory.py``): the run's ledger snapshots
+        (static per-leaf accounting, XLA memory_analysis waterfall,
+        census/allocator reconciliation), the per-epoch ``mem.*`` gauge
+        series, OOM events, and the ``peak_hbm_bytes`` scalar the
+        compare gate regresses on. With ``--oom`` the input is a raw
+        XLA RESOURCE_EXHAUSTED traceback instead, parsed into the typed
+        allocation report. Exit 1 when the history holds no memory
+        telemetry (or the text parses as no OOM).
+
     postmortem <dir> [<dir> ...] [--out bundle.json] [--annotate]
         [--tail N] [--format text|json]
         Crash forensics (``obs/postmortem.py``): walk the given dirs for
@@ -165,6 +176,23 @@ def main(argv=None) -> int:
     xp.add_argument("--top", type=int, default=10, metavar="K",
                     help="ops listed in the top-self-time table")
     xp.add_argument("--format", choices=("text", "json"), default="text")
+    mm = sub.add_parser(
+        "memory",
+        help="HBM report: ledger snapshots, mem.* gauge series, OOM "
+             "events, peak-HBM gate scalar (or --oom: parse a raw "
+             "RESOURCE_EXHAUSTED traceback)",
+    )
+    mm.add_argument(
+        "input",
+        help="a --log_file JSONL history (default) or, with --oom, a "
+             "text file holding an XLA RESOURCE_EXHAUSTED message",
+    )
+    mm.add_argument(
+        "--oom", action="store_true",
+        help="the input is a raw OOM traceback text, not a history — "
+             "parse it into the typed allocation report",
+    )
+    mm.add_argument("--format", choices=("text", "json"), default="text")
     pm = sub.add_parser(
         "postmortem",
         help="assemble per-rank crash-forensics bundles from a run's "
@@ -190,6 +218,50 @@ def main(argv=None) -> int:
                     help="ring records kept per rank in the bundle")
     pm.add_argument("--format", choices=("text", "json"), default="text")
     args = ap.parse_args(argv)
+
+    if args.cmd == "memory":
+        from tpu_dist.obs import memory as memory_lib
+
+        if args.oom:
+            try:
+                with open(args.input, errors="replace") as f:
+                    text = f.read()
+            except OSError as e:
+                print(f"tpu_dist.obs: cannot read {args.input}: {e}",
+                      file=sys.stderr)
+                return 2
+            report = memory_lib.parse_resource_exhausted(text)
+            if report is None:
+                print(
+                    f"tpu_dist.obs: {args.input} carries no "
+                    "RESOURCE_EXHAUSTED / out-of-memory signature",
+                    file=sys.stderr,
+                )
+                return 1
+            if args.format == "json":
+                print(json.dumps(report, indent=2))
+            else:
+                print(memory_lib.format_oom_text(report))
+            return 0
+        try:
+            records, _bad = summ.load_records(args.input)
+        except OSError as e:
+            print(f"tpu_dist.obs: cannot read {args.input}: {e}",
+                  file=sys.stderr)
+            return 2
+        report = memory_lib.memory_report(records)
+        if not (report["ledgers"] or report["epoch_series"]
+                or report["ooms"]):
+            print(
+                f"tpu_dist.obs: no memory telemetry (memory records or "
+                f"mem.* gauges) in {args.input}", file=sys.stderr,
+            )
+            return 1
+        if args.format == "json":
+            print(json.dumps(report, indent=2, default=str))
+        else:
+            print(memory_lib.format_report_text(report))
+        return 0
 
     if args.cmd == "postmortem":
         from tpu_dist.obs import postmortem as postmortem_lib

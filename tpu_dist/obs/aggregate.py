@@ -164,6 +164,10 @@ def pod_report(
             # latency/rate rollup — last window is the current state
             "serve_windows": rep.get("serve_windows", []),
             "serve_events": rep.get("serve_events", []),
+            # the memory layer (schema v11): the host's peak-HBM rollup
+            # + OOM events — the pod view's per-host memory skew input
+            "memory": rep.get("memory"),
+            "oom_events": rep.get("oom_events", []),
         })
     fracs = [
         h["goodput"]["goodput_frac"] for h in hosts
@@ -176,6 +180,11 @@ def pod_report(
         key=lambda h: h["goodput"].get("goodput_frac", 1.0),
         default=None,
     )
+    peaks = [
+        (h["host"], h["memory"]["peak_hbm_bytes"]) for h in hosts
+        if h.get("memory")
+        and isinstance(h["memory"].get("peak_hbm_bytes"), (int, float))
+    ]
     return {
         "n_hosts": len(hosts),
         "hosts": hosts,
@@ -187,6 +196,13 @@ def pod_report(
                 round(sum(fracs) / len(fracs), 4) if fracs else None
             ),
             "worst_goodput_host": worst["host"] if worst else None,
+            # cross-host peak-HBM spread: one hot HOST (after the
+            # per-chip skew inside each) is the pod's OOM risk
+            "peak_hbm_bytes_max": max((p for _, p in peaks), default=None),
+            "peak_hbm_bytes_min": min((p for _, p in peaks), default=None),
+            "worst_hbm_host": (
+                max(peaks, key=lambda hp: hp[1])[0] if peaks else None
+            ),
         },
     }
 
@@ -284,6 +300,38 @@ def format_text(report: dict) -> str:
             )
             for rank in sorted_ranks(pm.get("verdicts") or {}):
                 lines.append(f"  rank {rank}: {rank_summary(pm, rank)}")
+    # the memory layer (schema v11): per-host peak HBM + OOMs, and the
+    # pod-level spread — the hottest host is the pod's OOM risk even
+    # when every mean looks healthy
+    mem_hosts = [
+        h for h in report["hosts"]
+        if h.get("memory") or h.get("oom_events")
+    ]
+    if mem_hosts:
+        from tpu_dist.obs import memory as memory_lib
+
+        lines.append("per-host peak HBM (worst chip):")
+        for h in mem_hosts:
+            mem = h.get("memory") or {}
+            ooms = h.get("oom_events") or []
+            lines.append(
+                f"  {h['host'].ljust(w)} "
+                f"{memory_lib.fmt_bytes(mem.get('peak_hbm_bytes')):>10}"
+                + (f"  {len(ooms)} OOM event(s)" if ooms else "")
+            )
+        pod = report.get("pod") or {}
+        if isinstance(pod.get("peak_hbm_bytes_max"), (int, float)):
+            spread = pod["peak_hbm_bytes_max"] - (
+                pod.get("peak_hbm_bytes_min") or pod["peak_hbm_bytes_max"]
+            )
+            lines.append(
+                f"  pod: max {memory_lib.fmt_bytes(pod['peak_hbm_bytes_max'])}"
+                f" on {pod.get('worst_hbm_host')}"
+                + (
+                    f", cross-host spread {memory_lib.fmt_bytes(spread)}"
+                    if spread else ""
+                )
+            )
     # per-host profiler captures: paths + the xprof analysis rollup, so
     # the pod view answers WHERE each capture lives and WHAT it said —
     # not just who heartbeats and who straggles

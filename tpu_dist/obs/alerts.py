@@ -138,6 +138,14 @@ BUILTIN_RULES: Dict[str, AlertRule] = {
         # monotonic compile.retraces counter per window)
         AlertRule("retrace", "compile.retraces", ">", 0.0,
                   sustain=1, cooldown=1, delta=True, profile=True),
+        # the worst chip is within 10% of its HBM ceiling for 2 windows
+        # straight: the next shape change / fragmentation creep OOMs the
+        # pod. Fed by the mem.headroom_frac gauge (free fraction of the
+        # allocator's bytes_limit — obs/memory.py, trainer epoch gauges);
+        # backends without allocator limits (CPU) never observe the
+        # metric, so the rule stays silently unarmed there.
+        AlertRule("memory_headroom_low", "mem.headroom_frac", "<", 0.10,
+                  sustain=2, cooldown=3),
     )
 }
 

@@ -61,6 +61,13 @@ METRIC_DIRECTIONS: dict = {
     # points run to run on quiet captures.
     "overlap_frac": ("higher", 0.05),
     "collective_frac": ("lower", 0.03),
+    # the memory layer's gating scalar (schema v11 'memory' records +
+    # mem.* gauges, obs/memory.py): the run's worst observed per-chip
+    # peak HBM — HIGHER is a regression (a config that crept toward the
+    # chip ceiling fails CI before it OOMs a pod). Absolute slack of
+    # 1 MiB: allocator peaks wobble by small workspace allocations on
+    # otherwise identical runs, and a pure ratio would flag them.
+    "peak_hbm_bytes": ("lower", 1024 * 1024),
     # bench-mode per-record fields
     "value": ("higher", 0.0),          # images/sec (or tokens/sec)
     "sec_per_epoch": ("lower", 0.0),
@@ -115,6 +122,7 @@ REPORT_METRICS: Tuple[Tuple[str, str, float], ...] = _table((
     "images_per_sec_mean", "step_time_p50_s", "step_time_p95_s",
     "step_time_p99_s", "data_stall_frac", "mfu_mean", "final_loss",
     "final_val_top1", "goodput_frac", "overlap_frac", "collective_frac",
+    "peak_hbm_bytes",
 ))
 
 #: the ``--goodput`` gate's metric set: time-to-useful-work only. The
@@ -138,6 +146,10 @@ SLO_METRICS: Tuple[Tuple[str, str, float], ...] = _table((
 BENCH_FIELDS: Tuple[Tuple[str, str, float], ...] = _table((
     "value", "sec_per_epoch", "step_ms", "step_ms_p50", "step_ms_p95",
     "step_ms_p99", "mfu",
+    # bench records carry XLA's static per-step memory accounting
+    # (``peak_hbm_bytes`` from ``memory_analysis()``) — CPU-valid, so
+    # memory regressions gate even while the TPU tunnel is down
+    "peak_hbm_bytes",
     # serving bench records (bench.py --serve)
     "requests_per_s", "latency_p50_ms", "latency_p99_ms",
     "batch_occupancy",
@@ -188,6 +200,9 @@ def report_scalars(report: dict) -> dict:
         "serve_ttfb_p99_ms": _mean([w.get("ttfb_p99_ms") for w in sw]),
         "serve_availability": _mean([w.get("availability") for w in sw]),
         "serve_batch_occupancy": _mean([w.get("batch_occupancy") for w in sw]),
+        # the memory layer's worst observed per-chip peak (schema v11);
+        # None — skipped, never faked — on a memory-less / pre-v11 log
+        "peak_hbm_bytes": (report.get("memory") or {}).get("peak_hbm_bytes"),
     }
 
 

@@ -43,18 +43,47 @@ CHIP_PEAK_FLOPS = {
     "TPU v6e": 918e12,
 }
 
+_GIB = 1024 ** 3
+# HBM bytes per jax device (public spec-sheet numbers; a "device" is one
+# core on v2/v3 and one megacore chip from v4 on — exactly what
+# ``jax.devices()`` enumerates, so the budget divides the way shardings
+# do). Longest-prefix matched like the FLOP table; the pre-flight memory
+# lint (``obs/memory.py::preflight_check``) prices configs against this.
+CHIP_HBM_BYTES = {
+    "TPU v2": 8 * _GIB,
+    "TPU v3": 16 * _GIB,
+    "TPU v4": 32 * _GIB,
+    "TPU v5 lite": 16 * _GIB,
+    "TPU v5e": 16 * _GIB,
+    "TPU v5p": 95 * _GIB,
+    "TPU v5": 95 * _GIB,
+    "TPU v6 lite": 32 * _GIB,
+    "TPU v6e": 32 * _GIB,
+}
 
-def chip_peak_flops(kind: Optional[str] = None) -> Optional[float]:
-    """Peak FLOP/s for ``kind`` (default: the first visible device's
-    ``device_kind``); None for unknown kinds — CPU emulation above all."""
+
+def _chip_lookup(table: dict, kind: Optional[str]):
     if kind is None:
         import jax  # noqa: PLC0415
 
         kind = jax.devices()[0].device_kind
-    for name, peak in sorted(CHIP_PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+    for name, val in sorted(table.items(), key=lambda kv: -len(kv[0])):
         if kind.startswith(name):
-            return peak
+            return val
     return None
+
+
+def chip_peak_flops(kind: Optional[str] = None) -> Optional[float]:
+    """Peak FLOP/s for ``kind`` (default: the first visible device's
+    ``device_kind``); None for unknown kinds — CPU emulation above all."""
+    return _chip_lookup(CHIP_PEAK_FLOPS, kind)
+
+
+def chip_hbm_bytes(kind: Optional[str] = None) -> Optional[int]:
+    """Per-device HBM budget for ``kind`` (default: the first visible
+    device); None for unknown kinds — the memory lint then declines to
+    guess rather than refuse a run on a made-up budget."""
+    return _chip_lookup(CHIP_HBM_BYTES, kind)
 
 
 def _cost_dict(obj) -> dict:
@@ -129,24 +158,70 @@ def memory_analysis_bytes(compiled) -> Optional[dict]:
 
 
 def device_memory_stats() -> Optional[dict]:
-    """Live allocator counters of the first local device
-    (``bytes_in_use`` / ``peak_bytes_in_use``) — the TRUE peak-HBM gauge
-    on TPU/GPU, updated by the runtime itself. None where the backend
-    keeps no stats (CPU)."""
+    """Live allocator counters across ALL local devices — the TRUE
+    peak-HBM gauges on TPU/GPU, updated by the runtime itself. None
+    where no backend device keeps stats (CPU).
+
+    The scalar keys (``bytes_in_use`` / ``peak_bytes_in_use`` /
+    ``bytes_limit``) report the WORST chip — the max across local
+    devices, because HBM is a per-chip constraint and the hottest chip
+    is the one that OOMs. (The previous device-0-only read hid exactly
+    the failure this exists to surface: an unbalanced sharding whose hot
+    chip was any device but 0.) Multi-device processes additionally get
+    ``*_min`` floors, ``bytes_in_use_skew`` (max - min, the imbalance
+    gauge), and ``mem_devices_reporting``."""
     try:
         import jax  # noqa: PLC0415
 
-        stats = jax.local_devices()[0].memory_stats()
+        devices = jax.local_devices()
     except Exception:
         return None
-    if not stats:
+    per: list = []
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            per.append(stats)
+    if not per:
         return None
     out = {}
     for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
-        v = stats.get(key)
-        if isinstance(v, (int, float)):
-            out[key] = int(v)
+        vals = [
+            int(s[key]) for s in per
+            if isinstance(s.get(key), (int, float))
+        ]
+        if not vals:
+            continue
+        out[key] = max(vals)
+        if len(vals) > 1:
+            out[f"{key}_min"] = min(vals)
+    if "bytes_in_use_min" in out:
+        out["bytes_in_use_skew"] = (
+            out["bytes_in_use"] - out["bytes_in_use_min"]
+        )
+    if out:
+        out["mem_devices_reporting"] = len(per)
     return out or None
+
+
+def memory_analysis_jitted(jitted, *args) -> Optional[dict]:
+    """:func:`memory_analysis_bytes` of a ``jax.jit``-wrapped step: an
+    AOT ``lower(...).compile()`` pass purely to read XLA's memory
+    waterfall — jax exposes no handle to the executable the first
+    dispatch already cached, so this pays ONE extra host-side backend
+    compile (the ``jax.monitoring`` listener books it into
+    ``compile.seconds``, where the goodput ledger attributes it). The
+    trainer therefore captures it once per run and only when telemetry
+    consumers exist. None when lowering/compiling is unavailable —
+    callers degrade to the ledger without the waterfall, never to an
+    error."""
+    try:
+        compiled = jitted.lower(*args).compile()
+    except Exception:
+        return None
+    return memory_analysis_bytes(compiled)
 
 
 def analyze_jitted(jitted, *args, loop_trips: int = 1) -> Optional[dict]:

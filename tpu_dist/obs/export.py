@@ -308,6 +308,10 @@ KEY_GAUGES = (
     ("train.mfu", "mfu", ".3f"),
     ("goodput.goodput_frac", "goodput", ".1%"),
     ("compile.retraces", "retraces", "g"),
+    # the memory layer (obs/memory.py): worst-chip peak HBM and the free
+    # headroom fraction — a sick worker that was about to OOM says so
+    ("mem.peak_bytes_in_use", "peak_hbm_B", "g"),
+    ("mem.headroom_frac", "hbm_free", ".1%"),
 )
 
 

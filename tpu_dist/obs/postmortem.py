@@ -45,18 +45,20 @@ from typing import Dict, List, Optional, Tuple
 from tpu_dist.obs import export as export_lib
 from tpu_dist.obs import flight as flight_lib
 from tpu_dist.obs import heartbeat as heartbeat_lib
+from tpu_dist.obs import memory as memory_lib
 from tpu_dist.obs import summarize as summ
 
 #: Default bundle file name (written into the first scanned dir).
 BUNDLE_NAME = "postmortem.json"
 
 #: ``postmortem`` records stamp the CURRENT history schema (metrics/
-#: history.py — v9 introduced this kind). Kept as a literal so this
-#: module stays jax-free (the watchdog's auto-invoke and any laptop
-#: holding the copied files must not need a backend); pinned to the
-#: real SCHEMA_VERSION by ``tests/test_flight.py`` — the fleet-module
+#: history.py — v9 introduced this kind; v11 is current after the
+#: additive ``memory`` kind). Kept as a literal so this module stays
+#: jax-free (the watchdog's auto-invoke and any laptop holding the
+#: copied files must not need a backend); pinned to the real
+#: SCHEMA_VERSION by ``tests/test_flight.py`` — the fleet-module
 #: discipline (``FLEET_SCHEMA_VERSION``).
-POSTMORTEM_SCHEMA_VERSION = 10
+POSTMORTEM_SCHEMA_VERSION = 11
 
 #: Artifact stems recognized during discovery; each may carry the
 #: ``.h<k>`` per-rank suffix. History files are any ``*.jsonl``.
@@ -76,14 +78,15 @@ def _split_rank(name: str) -> Tuple[str, int]:
 def discover(dirs: List[str]) -> dict:
     """Walk the given dirs (non-recursive) and group forensic artifacts
     by rank: ``{"rings": {rank: path}, "stacks": {...}, "heartbeats":
-    {...}, "expositions": {...}, "histories": {rank: path}, "scanned":
-    [dirs that existed]}``. First occurrence of a (kind, rank) wins —
-    pass the most authoritative dir first."""
+    {...}, "expositions": {...}, "histories": {rank: path}, "ooms":
+    {rank: path}, "scanned": [dirs that existed]}``. First occurrence of
+    a (kind, rank) wins — pass the most authoritative dir first."""
     rings: Dict[int, str] = {}
     stacks: Dict[int, str] = {}
     hbs: Dict[int, str] = {}
     expos: Dict[int, str] = {}
     hists: Dict[int, str] = {}
+    ooms: Dict[int, str] = {}
     scanned: List[str] = []
     for d in dirs:
         try:
@@ -98,6 +101,8 @@ def discover(dirs: List[str]) -> dict:
                 rings.setdefault(rank, path)
             elif stem == flight_lib.STACKS_NAME:
                 stacks.setdefault(rank, path)
+            elif stem == memory_lib.OOM_NAME:
+                ooms.setdefault(rank, path)
             elif stem == _HB_STEM or (
                 stem.endswith(".json") and "hb" in stem.split(".")[0]
             ):
@@ -108,7 +113,8 @@ def discover(dirs: List[str]) -> dict:
                 hists.setdefault(rank, path)
     return {
         "rings": rings, "stacks": stacks, "heartbeats": hbs,
-        "expositions": expos, "histories": hists, "scanned": scanned,
+        "expositions": expos, "histories": hists, "ooms": ooms,
+        "scanned": scanned,
     }
 
 
@@ -167,12 +173,30 @@ def _exposition_section(path: str) -> Optional[dict]:
     return out
 
 
+def _fatal_oom(ring: Optional[dict]) -> Optional[dict]:
+    """The parsed OOM report hiding in a ring's fatal slot, when the
+    fatal message (truncated to the slot budget) still carries the
+    RESOURCE_EXHAUSTED signature — the fallback when the full
+    ``oom.json`` artifact was lost with the filesystem."""
+    fatal = (ring or {}).get("fatal")
+    if not fatal:
+        return None
+    text = f"{fatal.get('error')}: {fatal.get('message')}"
+    return memory_lib.parse_resource_exhausted(text)
+
+
 def _verdict(ring: Optional[dict], stack: Optional[dict],
-             heartbeat: Optional[dict]) -> str:
+             heartbeat: Optional[dict], oom: Optional[dict] = None) -> str:
     """Classify how the rank ended. A ring whose terminal record is
     ``exit``/``preempt``/``interrupt`` ended on its own terms; one that
     just stops (plus a left-behind heartbeat) is the wedge/hard-kill
-    signature ``obs postmortem`` exists for."""
+    signature ``obs postmortem`` exists for. A rank whose ``oom``
+    section was resolved (a left-behind ``oom.json``, or the fatal slot
+    re-parsed by the caller via :func:`_fatal_oom`) gets the distinct
+    ``oom`` verdict (obs/memory.py): the fix is sharding/batch math,
+    not a stack trace."""
+    if oom is not None:
+        return "oom"
     if ring and ring.get("fatal"):
         return "fatal"
     last = (ring or {}).get("last") or {}
@@ -199,7 +223,7 @@ def assemble(
     found = discover(dirs)
     ranks = sorted(
         set(found["rings"]) | set(found["stacks"]) | set(found["heartbeats"])
-        | set(found["expositions"])
+        | set(found["expositions"]) | set(found["ooms"])
     )
     rank_reports: List[dict] = []
     for rank in ranks:
@@ -219,13 +243,25 @@ def assemble(
             _exposition_section(found["expositions"][rank])
             if rank in found["expositions"] else None
         )
+        # the full OOM artifact (parsed allocation report + the ledger
+        # snapshot live at the crash) when the rank wrote one; else the
+        # report re-parsed out of the ring's truncated fatal slot
+        oom = (
+            memory_lib.read_oom_report(found["ooms"][rank])
+            if rank in found["ooms"] else None
+        )
+        if oom is None:
+            parsed = _fatal_oom(ring)
+            if parsed is not None:
+                oom = {"oom": parsed, "source": "flight_ring"}
         rank_reports.append({
             "rank": rank,
-            "verdict": _verdict(ring, stack, hb),
+            "verdict": _verdict(ring, stack, hb, oom),
             "flight": ring,
             "stack": stack,
             "heartbeat": hb,
             "exposition": expo,
+            **({"oom": oom} if oom is not None else {}),
         })
     histories = []
     for rank in sorted(found["histories"]):
@@ -289,6 +325,12 @@ def history_record(report: dict, bundle_path: Optional[str]) -> dict:
         for r in report["ranks"]
         if r.get("flight") and r["flight"].get("last_step")
     }
+    ooms = {
+        str(r["rank"]): memory_lib.oom_summary_line(r["oom"]["oom"])
+        for r in report["ranks"]
+        if isinstance(r.get("oom"), dict)
+        and isinstance(r["oom"].get("oom"), dict)
+    }
     rec = {
         "n_ranks": report["n_ranks"],
         "verdicts": verdicts,
@@ -299,6 +341,8 @@ def history_record(report: dict, bundle_path: Optional[str]) -> dict:
         rec["stuck_frames"] = stuck
     if fatal:
         rec["fatal"] = fatal
+    if ooms:
+        rec["oom"] = ooms
     if last_steps:
         rec["last_steps"] = last_steps
     return rec
@@ -327,11 +371,12 @@ def rank_summary(rec: dict, rank: str) -> str:
     verdict = (rec.get("verdicts") or {}).get(rank, "unknown")
     stuck = (rec.get("stuck_frames") or {}).get(rank)
     fatal = (rec.get("fatal") or {}).get(rank)
+    oom = (rec.get("oom") or {}).get(rank)
     ls = (rec.get("last_steps") or {}).get(rank) or {}
     return (
         str(verdict)
         + (f", stuck in {stuck}" if stuck else "")
-        + (f", fatal {fatal}" if fatal else "")
+        + (f", {oom}" if oom else (f", fatal {fatal}" if fatal else ""))
         + (
             f", flight ring ends at epoch {ls.get('epoch')} step "
             f"{ls.get('step')}" if ls else ""
@@ -433,6 +478,13 @@ def format_text(report: dict) -> str:
                     lines.append(
                         f"    thread {t.get('name') or '?'}: {t['top']}"
                     )
+        oom = r.get("oom")
+        if isinstance(oom, dict) and isinstance(oom.get("oom"), dict):
+            for ln in memory_lib.format_oom_text(oom["oom"]).splitlines():
+                lines.append(f"  {ln}")
+            led = oom.get("ledger")
+            if isinstance(led, dict):
+                lines.append("  " + memory_lib.summary_line(led))
         hb = r.get("heartbeat")
         if hb:
             lines.append(

@@ -23,13 +23,14 @@ from typing import List, Optional, Tuple
 
 from tpu_dist.obs import counters as counters_lib
 from tpu_dist.obs import goodput as goodput_lib
+from tpu_dist.obs import memory as memory_lib
 
 #: Newest history schema this reader fully understands
 #: (``metrics/history.py``). Records stamped newer still have their KNOWN
 #: kinds summarized; their unknown kinds are skipped with a count — the
 #: forward-compat contract that lets v3 tooling read v4 logs and vice
 #: versa (every schema bump is additive).
-SUPPORTED_SCHEMA = 10
+SUPPORTED_SCHEMA = 11
 
 #: Record kinds this reader folds into the report. Anything else is
 #: counted into ``skipped_kinds`` — never an error, never silent.
@@ -37,6 +38,7 @@ KNOWN_KINDS = frozenset((
     "train_epoch", "eval", "straggler", "anomaly", "device_stats",
     "auto_recover", "spans", "goodput", "profile", "alert",
     "profile_analysis", "resume", "fleet", "postmortem", "serve",
+    "memory",
 ))
 
 
@@ -79,6 +81,8 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
     postmortems: List[dict] = []  # crash bundles (schema v9)
     serve_windows: List[dict] = []  # serving SLO windows (schema v10)
     serve_events: List[dict] = []   # serving events (mid-serve retraces)
+    memory_records: List[dict] = []  # HBM-ledger snapshots (schema v11)
+    oom_events: List[dict] = []      # parsed RESOURCE_EXHAUSTED crashes
     dstats: dict = {}  # epoch -> per-epoch device_stats aggregate
     recoveries = 0
     prev_counters: Optional[dict] = None
@@ -208,6 +212,23 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
                               "queue_depth_max", "retraces", "phase_s")
                     if rec.get(k) is not None
                 })
+        elif kind == "memory":
+            # an HBM-ledger snapshot (schema v11, obs/memory.py): the
+            # first-dispatch static/census/allocator reconciliation, or
+            # an event:"oom" crash record with the parsed allocation
+            # report + the ledger that was live at the time
+            if rec.get("event") == "oom":
+                oom_events.append({
+                    k: rec.get(k) for k in ("epoch", "oom", "ledger")
+                    if rec.get(k) is not None
+                })
+            else:
+                memory_records.append({
+                    k: rec.get(k)
+                    for k in ("epoch", "static", "xla", "census",
+                              "reconciliation", "allocator", "feasibility")
+                    if rec.get(k) is not None
+                })
         elif kind == "profile":
             profiles.append({
                 k: rec.get(k)
@@ -285,6 +306,20 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
     times = [r["epoch_time_s"] for r in epochs if r.get("epoch_time_s")]
     ips = [r["images_per_sec"] for r in epochs if r.get("images_per_sec")]
     mfus = [r["mfu"] for r in epochs if isinstance(r.get("mfu"), (int, float))]
+    # the single gating scalar of the memory layer: the worst observed
+    # peak HBM — ledger snapshots first (allocator peak > xla estimate >
+    # census), the epoch-grain mem.* gauge series as the running floor
+    peak_hbm: Optional[int] = None
+    for mr in memory_records:
+        p = memory_lib.record_peak_hbm(mr)
+        if p is not None:
+            peak_hbm = max(peak_hbm or 0, p)
+    for rec in records:
+        cnt = rec.get("counters")
+        if isinstance(cnt, dict):
+            v = cnt.get("mem.peak_bytes_in_use")
+            if isinstance(v, (int, float)) and v > 0:
+                peak_hbm = max(peak_hbm or 0, int(v))
     out = {
         "run_id": run_id,
         "schema_version": schema,
@@ -300,6 +335,13 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
         "postmortems": postmortems,
         "serve_windows": serve_windows,
         "serve_events": serve_events,
+        "memory_records": memory_records,
+        "oom_events": oom_events,
+        "memory": (
+            {"peak_hbm_bytes": peak_hbm, "oom_events": len(oom_events)}
+            if (peak_hbm is not None or oom_events or memory_records)
+            else None
+        ),
         "stragglers": stragglers,
         "anomalies": anomalies,
         "alerts": alerts,
@@ -541,6 +583,26 @@ def format_text(report: dict) -> str:
                 f"({ev.get('n_real')} real request(s)) — the compiled "
                 "forward saw a new shape mid-serve"
             )
+    for mr in report.get("memory_records") or []:
+        # the full ledger through the ONE shared renderer (obs/memory.py
+        # — jax-free): summarize and the `obs memory` CLI cannot drift
+        lines.append(memory_lib.format_ledger_text(mr))
+    for o in report.get("oom_events") or []:
+        lines.append(
+            "OOM"
+            + (f" at epoch {o['epoch']}" if o.get("epoch") is not None else "")
+            + ": "
+            + (
+                memory_lib.oom_summary_line(o["oom"])
+                if isinstance(o.get("oom"), dict) else "RESOURCE_EXHAUSTED"
+            )
+        )
+    mem = report.get("memory")
+    if mem and mem.get("peak_hbm_bytes") is not None:
+        lines.append(
+            f"peak HBM: {memory_lib.fmt_bytes(mem['peak_hbm_bytes'])} "
+            "(worst chip — the compare gate's memory scalar)"
+        )
     gp_epochs = report.get("goodput_epochs") or []
     if gp_epochs:
         lines.append("goodput (seconds per window):")

@@ -250,6 +250,21 @@ class TailState:
                             if rec.get("retraces") else ""
                         )
                     )
+            elif kind == "memory":
+                # an HBM-ledger snapshot (schema v11) or an OOM event —
+                # through the shared obs/memory.py formatters, so tail,
+                # summarize, and the pod report render identically
+                from tpu_dist.obs import memory as memory_lib
+
+                if rec.get("event") == "oom":
+                    oom = rec.get("oom")
+                    self._event(
+                        memory_lib.oom_summary_line(oom)
+                        if isinstance(oom, dict)
+                        else "OOM: RESOURCE_EXHAUSTED (unparsed)"
+                    )
+                else:
+                    self._event(memory_lib.summary_line(rec))
             elif kind == "postmortem":
                 # a crash bundle landed (schema v9, the watchdog's
                 # auto-invoke): the run did NOT end cleanly — render the
@@ -268,8 +283,11 @@ class TailState:
                 verdicts = rec.get("verdicts") or {}
                 stuck = rec.get("stuck_frames") or {}
                 fatal = rec.get("fatal") or {}
+                oom = rec.get("oom") or {}
                 for rank in sorted_ranks(verdicts):
-                    if rank in fatal:
+                    if rank in oom:
+                        self._event(f"rank {rank}: {oom[rank]}")
+                    elif rank in fatal:
                         self._event(
                             f"fatal on rank {rank}: {fatal[rank]}"
                         )

@@ -878,6 +878,54 @@ class Trainer:
         self._step_cost = None
         # executable-cache watcher: counts compiles, flags mid-run retraces
         self._compile_watch = costmodel_lib.CompileWatcher(self.train_step)
+        # -- HBM pre-flight (obs/memory.py, docs/observability.md "HBM
+        # ledger & OOM forensics"): static per-leaf accounting of the
+        # state (params/opt-state/EF/BN at their SHARDED extents — a
+        # ZeRO-1 flat momentum counts ceil(L/n) per chip) plus one
+        # per-device input shard, priced against the per-chip HBM budget
+        # BEFORE the first compile can OOM — the lint ROADMAP item 3
+        # names. Pure shape/sharding metadata arithmetic; TD115 pins
+        # that arming it leaves the traced step byte-identical.
+        from tpu_dist.obs import memory as memory_lib  # noqa: PLC0415
+
+        batch_sds = None
+        try:
+            img, lbl = self.train_data
+            per_dev = max(cfg.batch_size // self.n_devices, 1)
+            batch_sds = {
+                "images": jax.ShapeDtypeStruct(
+                    (per_dev,) + tuple(img.shape[1:]), img.dtype
+                ),
+                "labels": jax.ShapeDtypeStruct((per_dev,), lbl.dtype),
+            }
+        except Exception:  # tpu-dist: ignore[TD006] — an exotic dataset
+            pass  # shape only costs the batch row, never the pre-flight
+        self._mem_static = memory_lib.static_ledger(
+            params=self.state.params, opt_state=self.state.opt_state,
+            ef=self.state.ef, bn_state=self.state.bn_state,
+            batch=batch_sds,
+        )
+        counters_lib.set_gauge(
+            "mem.static_bytes_per_device",
+            self._mem_static["bytes_per_device"],
+        )
+        self._mem_record = None  # the first-dispatch ledger snapshot
+        self._mem_feasibility = memory_lib.preflight_check(
+            self._mem_static["bytes_per_device"],
+            budget_bytes=cfg.hbm_budget_bytes,
+            headroom=cfg.memory_headroom,
+            action=cfg.memory_check,
+        )  # InfeasibleMemoryError under --memory_check refuse
+        if self._mem_feasibility and not self._mem_feasibility["fits"]:
+            rank0_print(
+                "WARNING: static HBM requirement "
+                f"{memory_lib.fmt_bytes(self._mem_feasibility['required_bytes'])}"
+                "/device exceeds "
+                f"{cfg.memory_headroom:.0%} of the "
+                f"{memory_lib.fmt_bytes(self._mem_feasibility['budget_bytes'])}"
+                " per-chip budget — expect RESOURCE_EXHAUSTED; shard more "
+                "or shrink the batch (--memory_check refuse stops here)"
+            )
         # run identity: config hash + construction second, stamped ONCE per
         # Trainer (docs/observability.md) — every history record of this
         # run carries the same id, repeated fit() calls included, and a
@@ -1633,15 +1681,55 @@ class Trainer:
         )
         self._step_cost = cost or {}
         costmodel_lib.publish(cost)
+        self._capture_memory_ledger(
+            runner if runner is not None else self.train_step, args
+        )
+
+    def _capture_memory_ledger(self, jitted, args) -> None:
+        """ONE HBM-ledger snapshot per Trainer, at first dispatch beside
+        the flops capture (obs/memory.py): the live-buffer census
+        reconciled against the allocator (attributed + unattributed ==
+        bytes_in_use, exact), the construction-time static ledger, and —
+        when telemetry consumers exist — the ``memory_analysis()``
+        waterfall of the step, which costs one extra host-side AOT
+        compile (booked into ``compile.seconds`` by the monitoring
+        listener) and is therefore skipped on telemetry-less runs.
+        Published as ``mem.*`` gauges and one ``memory`` history record
+        (schema v11)."""
+        if self._mem_record is not None:
+            return
+        from tpu_dist.obs import memory as memory_lib  # noqa: PLC0415
+
+        xla = None
+        if self._history is not None or self._exporter is not None:
+            xla = costmodel_lib.memory_analysis_jitted(jitted, *args)
+        rec = memory_lib.ledger(static=self._mem_static, xla=xla)
+        if self._mem_feasibility:
+            rec["feasibility"] = self._mem_feasibility
+        memory_lib.publish_ledger(rec)
+        self._mem_record = rec
+        if self._history is not None:
+            self._history.log("memory", **rec)
+        rank0_print("=> " + memory_lib.summary_line(rec))
 
     def _publish_memory_gauges(self) -> None:
         """Epoch-grain peak-HBM gauges from the runtime allocator's own
-        counters (the true device numbers on TPU/GPU; None on CPU, where
-        the backend keeps no stats — nothing is published)."""
+        counters (the true device numbers on TPU/GPU, now across ALL
+        local devices — the scalar keys are the WORST chip, with min/
+        skew gauges beside them; nothing is published on CPU, where the
+        backend keeps no stats). ``mem.headroom_frac`` — the free
+        fraction of the worst chip's limit — feeds the built-in
+        ``memory_headroom_low`` alert rule."""
         mem = costmodel_lib.device_memory_stats()
         if mem:
             for key, value in mem.items():
                 counters_lib.set_gauge(f"mem.{key}", value)
+            lim = mem.get("bytes_limit")
+            use = mem.get("bytes_in_use")
+            if lim and isinstance(use, (int, float)):
+                counters_lib.set_gauge(
+                    "mem.headroom_frac", round(1.0 - use / lim, 4)
+                )
 
     def _observe_health(self, epoch: int, step, nb: int, m: dict) -> None:
         """Per-fetch health layer over the metrics the loop already holds
@@ -2513,6 +2601,56 @@ class Trainer:
             self._alerts = None
             if telemetry:
                 self._export_telemetry(history)
+            # OOM forensics (obs/memory.py): a propagating
+            # RESOURCE_EXHAUSTED is parsed into a typed allocation
+            # report, logged as a 'memory' OOM event (schema v11) while
+            # the history is still open, stamped into the flight ring,
+            # and written as oom.json — with the ledger snapshot that
+            # was live at the time — next to the ring, so `obs
+            # postmortem` classifies this rank's verdict as 'oom'
+            # instead of an opaque 'fatal'.
+            import sys as _esys  # noqa: PLC0415
+
+            _oom_et, _oom_ev, _ = _esys.exc_info()
+            if _oom_et is not None:
+                from tpu_dist.obs import memory as memory_lib  # noqa: PLC0415
+
+                _oom = memory_lib.parse_resource_exhausted(str(_oom_ev))
+                if _oom is not None:
+                    counters_lib.inc("mem.oom_events")
+                    _snap = self._mem_record or {"static": self._mem_static}
+                    rank0_print(
+                        "FATAL: device "
+                        + memory_lib.oom_summary_line(_oom)
+                        + " — " + memory_lib.summary_line(_snap)
+                    )
+                    history.log(
+                        "memory", event="oom", epoch=self._last_epoch,
+                        oom=_oom, ledger=_snap,
+                    )
+                    if self._flight is not None:
+                        self._flight.record(
+                            "oom", epoch=self._last_epoch,
+                            requested=_oom.get("requested_bytes"),
+                            used=_oom.get("used_bytes"),
+                            limit=_oom.get("limit_bytes"),
+                        )
+                    if cfg.crash_dir:
+                        from tpu_dist.obs.heartbeat import (  # noqa: PLC0415
+                            per_rank_path,
+                        )
+
+                        import os as _oos  # noqa: PLC0415
+
+                        memory_lib.write_oom_report(
+                            per_rank_path(
+                                _oos.path.join(
+                                    cfg.crash_dir, memory_lib.OOM_NAME
+                                ),
+                                jax.process_index(),
+                            ),
+                            _oom, _snap,
+                        )
             self._history = None
             history.close()
             self._heartbeat = None
