@@ -1,6 +1,6 @@
 # Convenience targets; CI / the driver call the underlying commands directly.
 
-.PHONY: test quick bench csrc clean lint pod-report monitor profile-report elastic-drill fleet-drill postmortem-drill serve-drill serve-report memory-report
+.PHONY: test quick bench csrc clean lint shard-report pod-report monitor profile-report elastic-drill fleet-drill postmortem-drill serve-drill serve-report memory-report
 
 csrc:
 	$(MAKE) -C tpu_dist/csrc
@@ -12,6 +12,15 @@ test:
 # non-zero exit on any new violation (docs/analysis.md)
 lint:
 	python -m tpu_dist.analysis --format json
+
+# Layer 3 — the static HLO sharding & collective audit: lower+compile
+# every config family, parse the OPTIMIZED HLO (what GSPMD actually
+# emitted), gate TD116/TD117 (incl. the injected bad-in_shardings probe
+# that must be caught), and write the schema-pinned shard_report.json the
+# --auto_shard planner reads (docs/shard_report.md):
+#   make shard-report [OUT=shard_report.json]
+shard-report:
+	python -m tpu_dist.analysis shard --inject-reshard --out $(or $(OUT),shard_report.json)
 
 # <5-min cross-component slice (see tests/conftest.py for the curated set)
 quick:

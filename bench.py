@@ -110,6 +110,36 @@ def _wire_audit(fn, *args, trips: int = 1) -> dict | None:
         return None
 
 
+def _hlo_wire_audit(
+    compiled, loop_trips: int = 1, per_step_div: int = 1
+) -> int | None:
+    """HLO-derived wire bytes of ONE step, from the optimized module the
+    compiler actually emitted (the shardlint parser over
+    ``Compiled.as_text()`` — tpu_dist/analysis/shardlint.py). Stamped
+    beside the jaxpr ring model's ``wire_bytes_per_step`` so the two
+    accountings ride every bench record together, and gated by ``obs
+    compare --bench`` (higher = a compiled-comm regression: GSPMD grew a
+    reshard the jaxpr can't see). ``loop_trips`` prices ``while``-body
+    collectives at their trip count; ``per_step_div`` normalizes a
+    whole-epoch scan program back to one step. The two are SEPARATE so a
+    grad-accumulation step (trips=K, div=1) shows a collective that
+    drifted INTO the accumulation loop as a Kx wire regression instead
+    of hiding it. None (with a stderr note) on failure — CPU-valid, so
+    this gates while the TPU tunnel is down."""
+    import sys
+
+    try:
+        from tpu_dist.analysis.shardlint import parse_hlo_collectives
+
+        ops = parse_hlo_collectives(compiled.as_text(), loop_trips=loop_trips)
+        return sum(op.wire_bytes for op in ops) // per_step_div
+    except Exception as e:
+        print(f"bench: HLO wire-byte audit failed ({type(e).__name__}: "
+              f"{(str(e).splitlines() or [''])[0][:160]})",
+              file=sys.stderr, flush=True)
+        return None
+
+
 @dataclass(frozen=True)
 class BenchConfig:
     name: str
@@ -251,9 +281,12 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None,
         compiled = step.lower(state, images, labels, 0.1).compile()
         cost = _step_cost(compiled, loop_trips=cfg.grad_accum)
         hbm = _hbm_fields(compiled)
+        hlo_wire = _hlo_wire_audit(compiled, loop_trips=cfg.grad_accum)
         call = compiled
     except Exception:
-        cost, hbm = {"flops_per_step": None, "bytes_per_step": None}, {}
+        cost, hbm, hlo_wire = (
+            {"flops_per_step": None, "bytes_per_step": None}, {}, None,
+        )
         call = step
     flops_per_step = cost["flops_per_step"]
 
@@ -312,6 +345,8 @@ def run(cfg: BenchConfig, steps: int, warmup: int, n_devices: int | None = None,
         out["grad_compression"] = grad_compression
     if wire is not None:
         out["wire_bytes_per_step"] = wire
+    if hlo_wire is not None:
+        out["hlo_wire_bytes_per_step"] = hlo_wire
     if profile_dir:
         # read the capture back (obs/xprof): the attribution lands next to
         # the throughput it explains — a bench line with 40% collective
@@ -365,9 +400,15 @@ def _run_fused(cfg: BenchConfig, mesh, model, optimizer, state, n_dev: int,
         compiled = runner.lower(state, dx, dy, 0.1, 0).compile()
         cost = _step_cost(compiled, loop_trips=steps_per_epoch)
         hbm = _hbm_fields(compiled)
+        hlo_wire = _hlo_wire_audit(
+            compiled, loop_trips=steps_per_epoch,
+            per_step_div=steps_per_epoch,
+        )
         call = compiled
     except Exception:
-        cost, hbm = {"flops_per_step": None, "bytes_per_step": None}, {}
+        cost, hbm, hlo_wire = (
+            {"flops_per_step": None, "bytes_per_step": None}, {}, None,
+        )
         call = runner
     flops_per_epoch = cost["flops_per_step"]  # trips-scaled: whole epoch
 
@@ -416,6 +457,8 @@ def _run_fused(cfg: BenchConfig, mesh, model, optimizer, state, n_dev: int,
         out["grad_compression"] = grad_compression
     if wire is not None:
         out["wire_bytes_per_step"] = wire
+    if hlo_wire is not None:
+        out["hlo_wire_bytes_per_step"] = hlo_wire
     return _stamped(out)
 
 

@@ -112,6 +112,10 @@ _QUICK = (
     "test_fused_eval.py::test_fused_eval_counts_and_matches_direct_forward",
     "test_quantized_collectives.py::test_quantize_scale_correctness_and_error_bound",
     "test_quantized_collectives.py::test_td104_wire_bytes_int8_vs_bf16_vs_none",
+    "test_shardlint.py::test_parser_synthetic_module",
+    "test_shardlint.py::test_td116_matrix_clean_and_exact",
+    "test_shardlint.py::test_td117_injected_bad_in_shardings_caught",
+    "test_shardlint.py::test_rules_registry_matches_docs_table",
 )
 
 

@@ -322,6 +322,69 @@ def test_td006_inline_suppression():
     assert vs == []
 
 
+# -- TD008: rank-guarded collective call sites ------------------------------
+
+
+def test_td008_rank_guarded_collective_flagged():
+    vs = _lint(
+        """
+        import jax
+        from jax import lax
+        from tpu_dist.comm.collectives import barrier
+
+        def bad_branch(x, rank):
+            if rank == 0:
+                return lax.pmean(x, "data")
+            return x
+
+        def bad_early_return(x, rank):
+            if rank != 0:
+                return x
+            barrier()
+            return x
+        """
+    )
+    assert _rules(vs) == ["TD008", "TD008"]
+    assert "pmean" in vs[0].message
+    assert "deadlock" in vs[0].message
+
+
+def test_td008_unguarded_and_host_guard_pass():
+    # the correct shape: collective on EVERY rank, rank guard only
+    # around the host-side action — plus the audited inline-ignore
+    vs = _lint(
+        """
+        from jax import lax
+        from tpu_dist.metrics.logging import rank0_print
+
+        def good(x, rank):
+            y = lax.pmean(x, "data")
+            if rank == 0:
+                rank0_print(y)
+            return y
+
+        def audited(x, rank):
+            if rank == 0:
+                return lax.pmean(x, "data")  # tpu-dist: ignore[TD008] — single-process tool
+            return x
+        """
+    )
+    assert vs == []
+
+
+def test_td008_multihost_utils_and_polarity_inversion():
+    vs = _lint(
+        """
+        from jax.experimental import multihost_utils
+
+        def bad(tree, rank):
+            if not rank:
+                multihost_utils.sync_global_devices("ckpt")
+        """
+    )
+    assert _rules(vs) == ["TD008"]
+
+
 # -- suppressions & baseline ------------------------------------------------
 
 

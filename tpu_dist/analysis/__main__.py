@@ -2,7 +2,13 @@
 
 Exit codes: 0 clean (after suppressions + baseline), 1 violations,
 2 internal error. ``--format json`` emits one machine-readable object for
-the CI gate; text mode prints ``file:line:col: TDxxx message`` lines.
+the CI gate (including the full rule registry, the same source of truth
+docs/analysis.md's rule table is tested against); text mode prints
+``file:line:col: TDxxx message`` lines.
+
+``python -m tpu_dist.analysis shard`` runs Layer 3 — the static HLO
+sharding & collective audit (TD116/TD117) — and writes/prints the
+``shard_report.json`` planner input (docs/shard_report.md).
 """
 
 from __future__ import annotations
@@ -28,7 +34,96 @@ from tpu_dist.analysis.rules import RULES  # noqa: E402
 DEFAULT_BASELINE = "tools/analysis_baseline.json"
 
 
+def shard_main(argv) -> int:
+    """The ``shard`` subcommand: lower + compile every config family,
+    audit the optimized HLO (TD116/TD117), emit the shard report."""
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_dist.analysis shard",
+        description="static HLO sharding & collective audit (TD116/TD117) "
+        "— writes the shard_report.json the --auto_shard planner reads",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--out", default=None,
+        help="write the schema-pinned shard_report.json here",
+    )
+    ap.add_argument(
+        "--family", action="append",
+        help="analyze only this config family (repeatable)",
+    )
+    ap.add_argument("--list-families", action="store_true")
+    ap.add_argument(
+        "--inject-reshard", action="store_true",
+        help="ALSO analyze the deliberately mis-sharded ZeRO-1 probe "
+        "(bad in_shardings) — its TD117 findings are expected and prove "
+        "the detector is alive; exit 2 if it comes back clean",
+    )
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tpu_dist.analysis import shardlint
+    from tpu_dist.comm import mesh as mesh_lib
+
+    if args.list_families:
+        for name in shardlint.registered_families():
+            print(name)
+        return 0
+    unknown = sorted(
+        set(args.family or ()) - set(shardlint.registered_families())
+    )
+    if unknown:
+        print(
+            f"tpu_dist.analysis shard: unknown famil(ies) {unknown}; "
+            f"registered: {shardlint.registered_families()}",
+            file=sys.stderr,
+        )
+        return 2
+    report, violations = shardlint.build_shard_report(names=args.family)
+    if args.inject_reshard:
+        inj = shardlint.injected_bad_zero1(mesh_lib.data_parallel_mesh())
+        inj_report, inj_vs = shardlint.shard_case(
+            "zero1_sgd", step_override=inj
+        )
+        report["injected_reshard_probe"] = {
+            "violations": [v.to_json() for v in inj_vs],
+            "caught": bool(inj_vs),
+        }
+        if not inj_vs:
+            print(
+                "tpu_dist.analysis shard: the injected bad-in_shardings "
+                "probe came back CLEAN — the TD117 detector is dead",
+                file=sys.stderr,
+            )
+            return 2
+    if args.out:
+        shardlint.save_shard_report(report, args.out)
+    if args.format == "json":
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(shardlint.format_text(report))
+        for v in violations:
+            print(v.format_text())
+        if args.out:
+            print(f"shardlint: wrote {args.out}")
+    if report["counts"]["skipped"] and not args.family:
+        # a full run that silently skipped families must be loud (the
+        # robustness contract: degrade per family, fail the gate overall)
+        print(
+            f"tpu_dist.analysis shard: {report['counts']['skipped']} "
+            f"famil(ies) skipped: {report['skips']}",
+            file=sys.stderr,
+        )
+        return 2
+    return 1 if violations else 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "shard":
+        return shard_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m tpu_dist.analysis",
         description="distributed-training lint (TD0xx) + jaxpr audit (TD1xx)",
@@ -63,7 +158,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        for r in RULES.values():
+        for r in sorted(RULES.values(), key=lambda r: r.id):
             print(f"{r.id}  {r.name}\n      {r.summary}")
         return 0
 
@@ -126,6 +221,14 @@ def main(argv=None) -> int:
             "stale_baseline_entries": stale,
             "jaxpr_report": report.get("jaxpr", {}),
             "counts": {"new": len(violations), "stale_baseline": len(stale)},
+            # the FULL rule registry, in one machine-readable place — the
+            # same source of truth docs/analysis.md's rule table is tested
+            # against (tests/test_shardlint.py), so a rule cannot land
+            # half-registered
+            "rules": [
+                {"id": r.id, "name": r.name, "summary": r.summary}
+                for r in sorted(RULES.values(), key=lambda r: r.id)
+            ],
         }
         print(json.dumps(out, indent=2))
     else:

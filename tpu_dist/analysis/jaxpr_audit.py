@@ -373,27 +373,38 @@ _FUSED_STEPS = 2
 _BF16_CONVERTS = 6
 
 
+# The dp/zero1 flag combos come from the ONE config-family registry
+# (``train/step.py::SHARD_CONFIG_FAMILIES``) shared with the shardlint
+# HLO audit and the future --auto_shard planner — a family added there is
+# automatically the same flags here, so the two static accountings (jaxpr
+# ring model, compiled HLO) always describe the same program.
+
+
+def _family_setup(mesh, family: str):
+    from tpu_dist.train.step import family_step_kwargs
+
+    return _dp_setup(mesh, **family_step_kwargs(family))
+
+
 def _case_dp_sgd(mesh):
-    fn, args = _dp_setup(mesh)
+    fn, args = _family_setup(mesh, "dp_sgd")
     return fn, args, CollectiveBudget(dict(_DP_BUDGET), bf16_to_f32=None)
 
 
 def _case_dp_sgd_accum(mesh):
     # torch no_sync contract: K local sub-steps, ONE cross-replica reduce —
     # the budget is IDENTICAL to the K=1 step.
-    fn, args = _dp_setup(mesh, grad_accum_steps=4)
+    fn, args = _family_setup(mesh, "dp_sgd_accum4")
     return fn, args, CollectiveBudget(dict(_DP_BUDGET), bf16_to_f32=None)
 
 
 def _case_dp_bf16(mesh):
-    import jax.numpy as jnp
-
-    fn, args = _dp_setup(mesh, compute_dtype=jnp.bfloat16)
+    fn, args = _family_setup(mesh, "dp_bf16")
     return fn, args, CollectiveBudget(dict(_DP_BUDGET), bf16_to_f32=_BF16_CONVERTS)
 
 
 def _case_zero1_sgd(mesh):
-    fn, args = _dp_setup(mesh, shard_weight_update=True)
+    fn, args = _family_setup(mesh, "zero1_sgd")
     return fn, args, CollectiveBudget(dict(_ZERO1_BUDGET), bf16_to_f32=None)
 
 
@@ -401,24 +412,22 @@ def _case_dp_wire_bf16(mesh):
     # the bf16 WIRE format (grad_compression='bf16'; compute stays f32) —
     # the 2-bytes/element reference point of the TD104 wire ratios. NOT
     # dp_bf16, which is the bf16 COMPUTE policy over an f32 wire.
-    fn, args = _dp_setup(mesh, grad_compression="bf16")
+    fn, args = _family_setup(mesh, "dp_wire_bf16")
     return fn, args, CollectiveBudget(dict(_DP_BUDGET), bf16_to_f32=None)
 
 
 def _case_dp_int8(mesh):
-    fn, args = _dp_setup(mesh, grad_compression="int8")
+    fn, args = _family_setup(mesh, "dp_int8")
     return fn, args, CollectiveBudget(dict(_DP_INT8_BUDGET), bf16_to_f32=None)
 
 
 def _case_dp_int8_ef(mesh):
-    fn, args = _dp_setup(mesh, grad_compression="int8_ef")
+    fn, args = _family_setup(mesh, "dp_int8_ef")
     return fn, args, CollectiveBudget(dict(_DP_INT8_BUDGET), bf16_to_f32=None)
 
 
 def _case_zero1_int8(mesh):
-    fn, args = _dp_setup(
-        mesh, shard_weight_update=True, grad_compression="int8"
-    )
+    fn, args = _family_setup(mesh, "zero1_int8")
     return fn, args, CollectiveBudget(dict(_ZERO1_INT8_BUDGET), bf16_to_f32=None)
 
 
@@ -427,7 +436,7 @@ def _case_dp_device_metrics(mesh):
     # nonfinite count) are computed on the POST-pmean gradients — the
     # collective budget is IDENTICAL to the plain step's (TD107's
     # flag-on half, enforced here through the ordinary TD101 machinery)
-    fn, args = _dp_setup(mesh, device_metrics=True)
+    fn, args = _family_setup(mesh, "dp_device_metrics")
     return fn, args, CollectiveBudget(dict(_DP_BUDGET), bf16_to_f32=None)
 
 

@@ -27,6 +27,8 @@ import re
 from typing import Iterable, Optional
 
 from tpu_dist.analysis.rules import (
+    COLLECTIVE_CALL_NONMODULES,
+    COLLECTIVE_CALLS,
     COMPAT_MODULE_SUFFIX,
     FRAGILE_IMPORTS,
     HOST_SYNC_BUILTINS,
@@ -333,6 +335,42 @@ class _FileLint:
                     return True
         return False
 
+    def _rank_guard(self, node: ast.AST):
+        """The rank-dependent control flow that gates ``node`` (TD008):
+        an ancestor ``if`` whose test is a rank test of EITHER polarity —
+        unlike :meth:`_is_rank0_guarded`, which only certifies the rank-0
+        branch — or an earlier rank-early-return in the enclosing
+        function, after which the remaining body runs on a rank subset.
+        Returns the guarding statement, or None."""
+        child = node
+        anc = self.parent.get(node)
+        while anc is not None:
+            if (
+                isinstance(anc, ast.If)
+                and self._test_polarity(anc.test) is not None
+                and (
+                    any(child is s for s in anc.body)
+                    or any(child is s for s in anc.orelse)
+                )
+            ):
+                return anc
+            child, anc = anc, self.parent.get(anc)
+        fn = self._enclosing_function(node)
+        if fn is not None:
+            for stmt in fn.body:
+                if getattr(stmt, "lineno", 10**9) >= getattr(node, "lineno", 0):
+                    break
+                if (
+                    isinstance(stmt, ast.If)
+                    and self._test_polarity(stmt.test) is not None
+                    and any(
+                        isinstance(s, (ast.Return, ast.Raise)) for s in stmt.body
+                    )
+                    and not stmt.orelse
+                ):
+                    return stmt
+        return None
+
     def _enclosing_function(self, node: ast.AST):
         anc = self.parent.get(node)
         while anc is not None:
@@ -360,6 +398,7 @@ class _FileLint:
         self._check_bare_print(emit)
         self._check_jit_donate(emit)
         self._check_silent_except(emit)
+        self._check_rank_guarded_collective(emit)
         return out
 
     def _check_imports(self, emit) -> None:  # TD004
@@ -544,6 +583,41 @@ class _FileLint:
                 "surfaces as a collective deadlock; log it, re-raise, or "
                 "narrow to an allowlisted benign type "
                 f"({', '.join(sorted(TD006_ALLOWED_SILENT))})",
+            )
+
+    def _check_rank_guarded_collective(self, emit) -> None:  # TD008
+        """A collective call site gated by rank-dependent control flow —
+        the cross-host deadlock shape: only the guarded ranks reach the
+        collective, the rest block in whatever collective comes NEXT and
+        the job dies minutes later with an opaque timeout. Compute the
+        collective on every rank and guard the rank-local *action*
+        (print/write) instead. ``jnp.where``-style masking keeps the op
+        collective-uniform; rank-guarded call sites never are."""
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = self.resolve(node.func)
+            if resolved is None and isinstance(node.func, ast.Attribute):
+                last = node.func.attr
+            elif resolved is not None:
+                if resolved.startswith(COLLECTIVE_CALL_NONMODULES):
+                    continue
+                last = resolved.split(".")[-1]
+            else:
+                continue
+            if last not in COLLECTIVE_CALLS:
+                continue
+            guard = self._rank_guard(node)
+            if guard is None:
+                continue
+            emit(
+                "TD008",
+                node,
+                f"collective `{last}` is reachable only under the rank-"
+                f"dependent guard at line {guard.lineno} — ranks that "
+                "skip it block in the next matching collective "
+                "(cross-host deadlock); hoist the collective out of the "
+                "guard (compute everywhere, act on one rank)",
             )
 
     def _check_jit_donate(self, emit) -> None:  # TD003

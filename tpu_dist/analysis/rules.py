@@ -86,6 +86,15 @@ RULES: dict[str, Rule] = {
             "or inline-ignore with the audit reason",
         ),
         Rule(
+            "TD008",
+            "rank-guarded-collective",
+            "a collective call site reachable only under rank-/process-"
+            "dependent control flow — the guarded ranks enter the "
+            "collective, the rest never do, and the job dies as a "
+            "cross-host deadlock minutes later; hoist the collective out "
+            "of the guard (compute on every rank, act on one)",
+        ),
+        Rule(
             "TD101",
             "collective-budget-mismatch",
             "jaxpr collective count differs from the parallelism config's "
@@ -215,6 +224,26 @@ RULES: dict[str, Rule] = {
             "docs/observability.md 'HBM ledger & OOM forensics')",
         ),
         Rule(
+            "TD116",
+            "compiled-collectives-match-predicted",
+            "the optimized HLO's collective wire accounting disagrees "
+            "with the jaxpr-level TD104 ring model (elements exact; "
+            "integer/quantized legs byte-exact; float legs exact modulo "
+            "the backend's declared bf16->f32 normalization) — one of the "
+            "two accountings is lying about what the step moves "
+            "(tpu_dist/analysis/shardlint.py, docs/shard_report.md)",
+        ),
+        Rule(
+            "TD117",
+            "unintended-reshard-in-compiled-step",
+            "the optimized HLO contains a collective the jaxpr-level "
+            "inventory did not predict (an unpredicted op kind, or "
+            "per-kind wire bytes beyond the prediction) — GSPMD inserted "
+            "an implicit reshard, usually a bad in_shardings/out_shardings "
+            "gathering state the step expected resident "
+            "(tpu_dist/analysis/shardlint.py)",
+        ),
+        Rule(
             "TD104",
             "quantized-wire-bytes-over-budget",
             "gradient-collective payload bytes of a quantized wire format "
@@ -334,6 +363,31 @@ TD007_ALLOWED_PARTS = (
 
 # TD003 scope: jit calls inside these factory-name patterns are "hot path".
 HOT_FACTORY_REGEX = r"^(make|build)_.*(step|epoch|train|update)"
+
+# TD008: call targets that are (or transitively drive) a cross-process
+# collective, matched on the LAST dotted segment — the jax.lax primitives,
+# the tpu_dist.comm.collectives wrappers (reduce_mean/barrier/...), the
+# quantized two-stage reduce, and the multihost_utils host-level syncs.
+# Any of these reachable only under a rank-dependent `if` is the classic
+# deadlock shape: the guarded ranks enter the collective, the rest never
+# do. `broadcast_from` IS rank-aware internally (every rank calls it) —
+# what TD008 flags is a rank-guarded CALL SITE, where some rank skips the
+# call entirely.
+COLLECTIVE_CALLS = {
+    # jax.lax primitives
+    "psum", "pmean", "pmin", "pmax", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "psum_scatter", "pgather",
+    # tpu_dist.comm.collectives / quantize wrappers
+    "reduce_mean", "reduce_sum", "broadcast_from", "barrier",
+    "host_allreduce_mean", "quantized_pmean_flat",
+    # jax.experimental.multihost_utils host-level syncs
+    "broadcast_one_to_all", "process_allgather", "sync_global_devices",
+    "reached_preemption_sync_point",
+}
+# ...except these receivers/modules, where a same-named method is host
+# bookkeeping, not a collective (e.g. ``Counter``-style .barrier attrs).
+# Matched on the resolved dotted prefix when resolution succeeds.
+COLLECTIVE_CALL_NONMODULES = ("threading.", "multiprocessing.")
 
 # TD006: exception types a `pass`-only handler may swallow without comment —
 # probe/cleanup idioms where absence IS the answer. Matched on the LAST

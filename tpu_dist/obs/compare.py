@@ -79,6 +79,13 @@ METRIC_DIRECTIONS: dict = {
     # serving (``--slo`` gate + bench --serve records, serve/slo.py):
     # latency/queue metrics are lower-is-better; a LOWER-latency
     # candidate is an improvement and must never be flagged.
+    # compiled-communication accounting (bench records, shardlint —
+    # tpu_dist/analysis/shardlint.py): wire bytes of ONE step derived from
+    # the optimized HLO the compiler actually emitted. HIGHER is a
+    # regression — GSPMD grew an implicit reshard or a wire leg widened —
+    # and the number is static+deterministic (zero slack), so a compiled-
+    # comm regression gates in CI even while the TPU tunnel is down.
+    "hlo_wire_bytes_per_step": ("lower", 0.0),
     "requests_per_s": ("higher", 0.0),
     "serve_requests_per_s": ("higher", 0.0),
     "latency_p50_ms": ("lower", 0.0),
@@ -150,6 +157,9 @@ BENCH_FIELDS: Tuple[Tuple[str, str, float], ...] = _table((
     # (``peak_hbm_bytes`` from ``memory_analysis()``) — CPU-valid, so
     # memory regressions gate even while the TPU tunnel is down
     "peak_hbm_bytes",
+    # ...and the compiled-collective wire bytes (shardlint over the
+    # optimized HLO), the communication twin of that memory gate
+    "hlo_wire_bytes_per_step",
     # serving bench records (bench.py --serve)
     "requests_per_s", "latency_p50_ms", "latency_p99_ms",
     "batch_occupancy",

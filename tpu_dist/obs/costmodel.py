@@ -206,6 +206,57 @@ def device_memory_stats() -> Optional[dict]:
     return out or None
 
 
+# One AOT lower+compile per (step, abstract signature), shared by
+# ANALYSIS consumers that re-read the same executable's artifacts — the
+# shardlint HLO text + cost analysis + memory waterfall
+# (tpu_dist/analysis/shardlint.py) all read ONE compile instead of
+# paying three. Long-lived training processes must NOT route their
+# one-shot probes through here (see memory_analysis_jitted): values hold
+# a strong ref to the jitted wrapper so the id() key cannot be recycled,
+# which pins the executable until eviction. Bounded by
+# :data:`_COMPILE_CACHE_MAX` (FIFO — the cache exists to dedupe within
+# one analysis pass, not to live forever).
+_COMPILE_CACHE: dict = {}
+_COMPILE_CACHE_MAX = 32
+
+
+def _aot_key(jitted, args) -> tuple:
+    import jax  # noqa: PLC0415
+
+    leaves = jax.tree_util.tree_leaves(args)
+    sig = tuple(
+        # arrays key on (shape, dtype); non-array leaves (python scalars,
+        # static args) key on their VALUE — two lowers of the same jitted
+        # fn with different static args must not collide on one executable
+        (tuple(x.shape), str(x.dtype))
+        if hasattr(x, "shape") and hasattr(x, "dtype")
+        else ("val", repr(x)[:128])
+        for x in leaves
+    )
+    return (id(jitted), sig)
+
+
+def lower_and_compile(jitted, *args):
+    """``(Lowered, Compiled)`` of a jitted step at ``args``' abstract
+    signature, cached — the lower-and-cache seam every static analysis
+    shares. Raises whatever lowering/compiling raises (callers that want
+    degradation wrap it; the analyzers want the real error)."""
+    key = _aot_key(jitted, args)
+    hit = _COMPILE_CACHE.get(key)
+    if hit is not None:
+        return hit[1], hit[2]
+    lowered = jitted.lower(*args)
+    compiled = lowered.compile()
+    if len(_COMPILE_CACHE) >= _COMPILE_CACHE_MAX:
+        _COMPILE_CACHE.pop(next(iter(_COMPILE_CACHE)))
+    _COMPILE_CACHE[key] = (jitted, lowered, compiled)
+    return lowered, compiled
+
+
+def clear_compile_cache() -> None:
+    _COMPILE_CACHE.clear()
+
+
 def memory_analysis_jitted(jitted, *args) -> Optional[dict]:
     """:func:`memory_analysis_bytes` of a ``jax.jit``-wrapped step: an
     AOT ``lower(...).compile()`` pass purely to read XLA's memory
@@ -214,9 +265,14 @@ def memory_analysis_jitted(jitted, *args) -> Optional[dict]:
     compile (the ``jax.monitoring`` listener books it into
     ``compile.seconds``, where the goodput ledger attributes it). The
     trainer therefore captures it once per run and only when telemetry
-    consumers exist. None when lowering/compiling is unavailable —
-    callers degrade to the ledger without the waterfall, never to an
-    error."""
+    consumers exist — and deliberately does NOT go through the
+    :func:`lower_and_compile` cache: pinning a second full executable of
+    the TRAIN step for the rest of a run would raise steady-state host
+    memory on exactly the memory-constrained runs this instruments (the
+    cache is for analysis passes that re-read one executable's
+    artifacts, e.g. shardlint). None when lowering/compiling is
+    unavailable — callers degrade to the ledger without the waterfall,
+    never to an error."""
     try:
         compiled = jitted.lower(*args).compile()
     except Exception:
@@ -443,6 +499,75 @@ def publish_calibration(gauges: dict) -> None:
     (``counters.snapshot`` feeds both)."""
     for name, v in gauges.items():
         counters_lib.set_gauge(name, v)
+
+
+def predicted_step_time(
+    cost: Optional[dict],
+    *,
+    wire_bytes: Optional[int] = None,
+    n_devices: int = 1,
+    gauges: Optional[dict] = None,
+    peak: Optional[float] = None,
+) -> dict:
+    """Static step-time prediction, corrected by the latest measured
+    ``cost.calibration_*`` gauges — the scalar an ``--auto_shard`` planner
+    ranks mesh layouts with (ROADMAP item 3; the shard report stamps it
+    per config family).
+
+    Model (documented, deliberately simple): compute time is the step's
+    FLOPs over the ACHIEVED FLOP/s from the last calibrated capture
+    (falling back to the spec-sheet chip peak when no capture exists —
+    ``source`` says which); memory time is XLA's bytes-accessed over the
+    achieved bytes/s; communication time is the HLO wire bytes over the
+    same achieved bytes/s (a proxy until an ICI-rate gauge exists —
+    recorded as such). Compute and memory overlap perfectly inside a
+    fused step (``max``); communication hides behind compute by the
+    measured ``overlap_frac`` (0 when never measured). Returns ``{}``
+    when there is nothing to price (no flops and no bytes)."""
+    gauges = gauges if gauges is not None else counters_lib.snapshot()
+    cost = cost or {}
+    flops = cost.get("flops_per_step")
+    byts = cost.get("bytes_per_step")
+    flops_rate = gauges.get("cost.calibration_flops_per_s")
+    bytes_rate = gauges.get("cost.calibration_bytes_per_s")
+    overlap = gauges.get("cost.calibration_overlap_frac") or 0.0
+    source = "calibrated"
+    if not isinstance(flops_rate, (int, float)) or flops_rate <= 0:
+        if peak is None:
+            peak = chip_peak_flops()
+        flops_rate = peak * n_devices if peak else None
+        source = "spec_peak"
+    out: dict = {}
+    t_compute = (
+        flops / flops_rate
+        if isinstance(flops, (int, float)) and flops > 0 and flops_rate
+        else None
+    )
+    t_mem = (
+        byts / bytes_rate
+        if isinstance(byts, (int, float)) and byts > 0
+        and isinstance(bytes_rate, (int, float)) and bytes_rate > 0
+        else None
+    )
+    t_comm = (
+        wire_bytes / bytes_rate
+        if isinstance(wire_bytes, (int, float)) and wire_bytes > 0
+        and isinstance(bytes_rate, (int, float)) and bytes_rate > 0
+        else None
+    )
+    if t_compute is None and t_mem is None:
+        return out
+    busy = max(t for t in (t_compute, t_mem) if t is not None)
+    exposed_comm = (t_comm or 0.0) * (1.0 - min(max(overlap, 0.0), 1.0))
+    out = {
+        "predicted_step_s": _sig(busy + exposed_comm),
+        "compute_s": _sig(t_compute) if t_compute is not None else None,
+        "memory_s": _sig(t_mem) if t_mem is not None else None,
+        "comm_s": _sig(t_comm) if t_comm is not None else None,
+        "overlap_frac_applied": round(float(overlap), 4),
+        "rate_source": source,
+    }
+    return out
 
 
 def publish(cost: Optional[dict]) -> None:

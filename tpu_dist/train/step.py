@@ -60,6 +60,42 @@ def extract_aux_loss(new_bn):
 
 GRAD_COMPRESSION_MODES = ("none", "bf16", "int8", "int8_ef")
 
+# ONE registry of the shard-auditable parallelism config families: name →
+# the :func:`make_train_step` kwargs that select the family. This is the
+# enumeration the static analyzers walk (the jaxpr audit's budget cases,
+# the shardlint HLO audit — tpu_dist/analysis) and the search space a
+# measurement-calibrated ``--auto_shard`` planner ranks over (ROADMAP
+# item 3): every entry lowers to a distinct collective inventory, and
+# each gets its own verified entry in ``shard_report.json``
+# (docs/shard_report.md). Families that need a model/mesh beyond the flag
+# combo (fsdp's per-leaf specs, tp's param_specs, sp's ring-attention
+# model) carry the axis flags here and get their builders in
+# ``analysis/shardlint.py``.
+SHARD_CONFIG_FAMILIES: dict = {
+    "dp_sgd": {},
+    "dp_sgd_accum4": {"grad_accum_steps": 4},
+    "dp_bf16": {"compute_dtype": "bfloat16"},  # compute policy, f32 wire
+    "dp_wire_bf16": {"grad_compression": "bf16"},
+    "dp_int8": {"grad_compression": "int8"},
+    "dp_int8_ef": {"grad_compression": "int8_ef"},
+    "zero1_sgd": {"shard_weight_update": True},
+    "zero1_int8": {"shard_weight_update": True, "grad_compression": "int8"},
+    "dp_device_metrics": {"device_metrics": True},
+    "tp": {"tp_axis": "model"},    # + param_specs from the model
+    "sp": {"seq_axis": "seq"},     # + a ring-attention model
+    "fsdp": {},                    # the GSPMD engine (parallel/fsdp.py)
+}
+
+
+def family_step_kwargs(name: str) -> dict:
+    """Resolve a :data:`SHARD_CONFIG_FAMILIES` entry to real
+    :func:`make_train_step` kwargs (the registry stores dtypes by NAME so
+    it stays a plain-data enumeration planners can serialize)."""
+    kw = dict(SHARD_CONFIG_FAMILIES[name])
+    if isinstance(kw.get("compute_dtype"), str):
+        kw["compute_dtype"] = jnp.dtype(kw["compute_dtype"]).type
+    return kw
+
 # Modes that use the quantized two-stage reduce below. They are scoped to
 # the plain data-parallel reduce (per-step and fused-epoch) and the ZeRO-1
 # reduce-scatter; the model-parallel reduces (tp/ep/pp/sp) keep the cast
