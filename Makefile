@@ -1,6 +1,6 @@
 # Convenience targets; CI / the driver call the underlying commands directly.
 
-.PHONY: test quick bench csrc clean lint shard-report pod-report monitor profile-report elastic-drill fleet-drill postmortem-drill serve-drill serve-report memory-report
+.PHONY: test quick bench csrc clean lint shard-report plan-report pod-report monitor profile-report elastic-drill fleet-drill postmortem-drill serve-drill serve-report memory-report
 
 csrc:
 	$(MAKE) -C tpu_dist/csrc
@@ -21,6 +21,17 @@ lint:
 #   make shard-report [OUT=shard_report.json]
 shard-report:
 	python -m tpu_dist.analysis shard --inject-reshard --out $(or $(OUT),shard_report.json)
+
+# Layer 4 — the sharding planner: enumerate + price the config-family
+# space (calibrated roofline over the HLO-verified wire bytes), refuse
+# over-budget candidates through the typed HBM path, rank, verify the
+# chosen plan against a fresh compile (TD118 — incl. the injected
+# miscost probe that must be caught, exit 2 if the detector went dead),
+# and write the schema-pinned plan_report.json the trainer's
+# --auto_shard consumes (docs/planner.md):
+#   make plan-report [OUT=plan_report.json]
+plan-report:
+	python -m tpu_dist.analysis plan --inject-miscost --out $(or $(OUT),plan_report.json)
 
 # <5-min cross-component slice (see tests/conftest.py for the curated set)
 quick:

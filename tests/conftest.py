@@ -116,6 +116,13 @@ _QUICK = (
     "test_shardlint.py::test_td116_matrix_clean_and_exact",
     "test_shardlint.py::test_td117_injected_bad_in_shardings_caught",
     "test_shardlint.py::test_rules_registry_matches_docs_table",
+    "test_planner.py::test_build_plan_is_deterministic",
+    "test_planner.py::test_hbm_budget_refusal_matrix",
+    "test_planner.py::test_price_candidate_gauge_arithmetic",
+    "test_planner.py::test_td118_inject_miscost_must_be_caught",
+    "test_planner.py::test_td119_direction_registered_and_gates",
+    "test_optim.py::test_lars_lamb_golden_trajectory_pins",
+    "test_optim.py::test_linear_scaling_rule_and_warmup",
 )
 
 

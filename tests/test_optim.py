@@ -4,6 +4,7 @@
 import jax
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from tpu_dist.train.optim import SGD, multistep_lr
 
@@ -177,6 +178,145 @@ def test_fsdp_adamw_matches_plain(tmp_path):
         jax.tree_util.tree_leaves(plain.params), jax.tree_util.tree_leaves(fsdp.params)
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def _large_batch_trajectory(opt, steps=4, lr=0.1):
+    """Shared deterministic trajectory for the LARS/LAMB golden pins: a
+    2-D weight (adapted + decayed) and a 1-D bias (excluded, like
+    AdamW's ``auto`` mask)."""
+    w0 = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+    b0 = (np.ones(3) * 0.5).astype(np.float32)
+    p = {"w": jnp.array(w0), "b": jnp.array(b0)}
+    s = opt.init(p)
+    for i in range(steps):
+        g = {
+            "w": jnp.array(np.random.default_rng(i + 1).normal(size=(4, 3)).astype(np.float32)),
+            "b": jnp.array(np.random.default_rng(100 + i).normal(size=(3,)).astype(np.float32)),
+        }
+        p, s = opt.update(g, s, p, lr)
+    return p, s
+
+
+def test_lars_matches_numpy_reference():
+    """4 steps against an independent numpy transcription of the paper's
+    update: ``local = η‖p‖/(‖g‖+wd‖p‖)``, momentum on the decayed+scaled
+    gradient, rank≤1 leaves plain SGD-momentum."""
+    from tpu_dist.train.optim import LARS
+
+    mu, wd, eta, eps = 0.9, 1e-4, 1e-3, 1e-9
+    w = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+    b = (np.ones(3) * 0.5).astype(np.float32)
+    bw = np.zeros_like(w)
+    bb = np.zeros_like(b)
+    for i in range(4):
+        gw = np.random.default_rng(i + 1).normal(size=(4, 3)).astype(np.float32)
+        gb = np.random.default_rng(100 + i).normal(size=(3,)).astype(np.float32)
+        pn, gn = np.linalg.norm(w), np.linalg.norm(gw)
+        local = eta * pn / (gn + wd * pn + eps) if pn > 0 and gn > 0 else 1.0
+        bw = mu * bw + local * (gw + wd * w)
+        w = w - 0.1 * bw
+        bb = mu * bb + gb  # no adaptation, no decay on rank-1
+        b = b - 0.1 * bb
+
+    p, _ = _large_batch_trajectory(LARS())
+    np.testing.assert_allclose(np.asarray(p["w"]), w, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(p["b"]), b, rtol=1e-5, atol=1e-6)
+
+
+def test_lamb_matches_numpy_reference():
+    """Bias-corrected Adam direction, decoupled decay folded into the
+    update, then the ‖p‖/‖u‖ trust ratio — numpy-transcribed."""
+    from tpu_dist.train.optim import LAMB
+
+    b1, b2, eps, wd = 0.9, 0.999, 1e-6, 0.01
+    w = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+    b = (np.ones(3) * 0.5).astype(np.float32)
+    mw = np.zeros_like(w); vw = np.zeros_like(w)
+    mb = np.zeros_like(b); vb = np.zeros_like(b)
+    for i in range(4):
+        gw = np.random.default_rng(i + 1).normal(size=(4, 3)).astype(np.float32)
+        gb = np.random.default_rng(100 + i).normal(size=(3,)).astype(np.float32)
+        t = i + 1
+        bc1, bc2 = 1.0 - b1 ** t, 1.0 - b2 ** t
+        mw = b1 * mw + (1 - b1) * gw; vw = b2 * vw + (1 - b2) * gw**2
+        mb = b1 * mb + (1 - b1) * gb; vb = b2 * vb + (1 - b2) * gb**2
+        uw = (mw / bc1) / (np.sqrt(vw / bc2) + eps) + wd * w
+        r = np.linalg.norm(w) / (np.linalg.norm(uw) + eps)
+        w = w - 0.1 * r * uw
+        ub = (mb / bc1) / (np.sqrt(vb / bc2) + eps)  # no decay, ratio 1
+        b = b - 0.1 * ub
+
+    p, _ = _large_batch_trajectory(LAMB())
+    np.testing.assert_allclose(np.asarray(p["w"]), w, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(p["b"]), b, rtol=1e-4, atol=1e-5)
+
+
+def test_lars_lamb_golden_trajectory_pins():
+    """Hard numeric pins of the shared trajectory — a silent change to
+    either update rule (new default, reordered decay, dropped bias
+    correction) moves these and fails loudly."""
+    from tpu_dist.train.optim import LAMB, LARS
+
+    p, s = _large_batch_trajectory(LARS())
+    assert float(jnp.sum(p["w"])) == pytest.approx(0.26377815, rel=1e-4)
+    assert float(p["w"][0, 0]) == pytest.approx(0.12542857, rel=1e-4)
+    assert float(jnp.sum(p["b"])) == pytest.approx(1.37308383, rel=1e-4)
+    # momentum state mirrors the param tree (ckpt/state_specs contract)
+    assert set(s) == {"w", "b"}
+
+    p, s = _large_batch_trajectory(LAMB())
+    assert float(jnp.sum(p["w"])) == pytest.approx(-1.01437378, rel=1e-4)
+    assert float(p["w"][0, 0]) == pytest.approx(-0.18847042, rel=1e-4)
+    assert float(jnp.sum(p["b"])) == pytest.approx(1.30420136, rel=1e-4)
+    # state layout is AdamW's exactly — checkpoints interop
+    assert set(s) == {"mu", "nu", "count"}
+    assert int(np.asarray(s["count"])) == 4
+
+
+def test_linear_scaling_rule_and_warmup():
+    from tpu_dist.train.optim import linear_scaled_lr
+
+    assert linear_scaled_lr(0.1, 256, 2048) == pytest.approx(0.8)
+    assert linear_scaled_lr(0.1, 256, 256) == pytest.approx(0.1)
+    with pytest.raises(ValueError):
+        linear_scaled_lr(0.1, 0, 256)
+    with pytest.raises(ValueError):
+        linear_scaled_lr(0.1, 256, -1)
+
+    # warmup ramps linearly to base_lr, then the milestones take over
+    sched = multistep_lr(0.8, (10, 20), 0.1, warmup_epochs=5)
+    assert sched(0) == pytest.approx(0.8 / 5)
+    assert sched(3) == pytest.approx(0.8 * 4 / 5)
+    assert sched(4) == pytest.approx(0.8)
+    assert sched(9) == pytest.approx(0.8)
+    assert sched(10) == pytest.approx(0.08)
+    # warmup_epochs=0 stays the reference MultiStepLR (no ramp)
+    assert multistep_lr(0.8, (10,), 0.1)(0) == pytest.approx(0.8)
+
+
+def test_trainer_lars_e2e_and_refusals(tmp_path):
+    """LARS end-to-end through the Trainer with the full large-batch
+    recipe (linear scaling + warmup), plus the two config refusals: the
+    fused SGD kernel and the ZeRO-1 flat layout both destroy the
+    per-layer norms LARS needs."""
+    import pytest
+
+    from tpu_dist.config import TrainConfig
+    from tpu_dist.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        dataset="synthetic", model="vit_tiny", num_classes=10, batch_size=64,
+        epochs=1, steps_per_epoch=2, log_every=10, lr=0.1, lr_base_batch=256,
+        warmup_epochs=1, eval_every=0, optimizer="lars", sync_bn=False,
+        synthetic_n=256,
+    )
+    out = Trainer(cfg).fit()
+    assert np.isfinite(out["loss"])
+
+    with pytest.raises(ValueError, match="fused"):
+        Trainer(cfg.replace(optimizer="lars", fused_optimizer=True))
+    with pytest.raises(ValueError, match="ZeRO-1"):
+        Trainer(cfg.replace(optimizer="lamb", shard_weight_update=True))
 
 
 def test_trainer_adamw_tp_e2e():

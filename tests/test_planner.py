@@ -1,0 +1,462 @@
+"""Layer 4 (the ``--auto_shard`` planner) tested: search determinism (a
+plan is a pure function of its inputs), the HBM-budget refusal matrix
+through the typed ``--memory_check`` path, the calibration-gauge pricing
+arithmetic, the TD118 plan-must-verify gate + the ``--inject-miscost``
+dead-detector contract, the TD119 history/compare gate, the plan_report
+schema round-trip with the forward-compat (skip-with-count) loader, the
+registry pins (planner overrides vs step.py families, rules vs docs),
+and the CLI exit contracts."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_dist.analysis import planner, shardlint
+from tpu_dist.analysis.planner import PlanReportError
+from tpu_dist.analysis.rules import RULES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# explicit per-device budgets for the refusal matrix (bytes). The audit
+# MLP's static ledger is ~3.8KiB/dev plain-DP and ~2.1KiB/dev under
+# ZeRO-1, so 3000 B splits the two and 1000 B refuses both; computed
+# budgets in the tests derive from the measured entries, these are only
+# the coarse grid.
+_BIG = 10**9
+
+
+@pytest.fixture(scope="module")
+def dp_report():
+    """One shard-report over three families, shared by every pricing
+    test in the module (compiling is the expensive part; planning from
+    a report is pure arithmetic)."""
+    report, violations = shardlint.build_shard_report(
+        names=["dp_sgd", "zero1_sgd", "dp_int8"]
+    )
+    assert report["skips"] == {}
+    assert violations == []
+    return report
+
+
+# -- search determinism ------------------------------------------------------
+
+
+def test_build_plan_is_deterministic(dp_report):
+    """Same inputs, same plan — byte-for-byte. No wall clock, no dict
+    order, no RNG anywhere in the search."""
+    kw = dict(shard_report=dp_report, hbm_budget_bytes=_BIG)
+    a = planner.build_plan(**kw)
+    b = planner.build_plan(**kw)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    # ranking is (predicted_step_s, family): sorted and 1-based
+    ranks = [r["rank"] for r in a["candidates"]]
+    assert ranks == list(range(1, len(ranks) + 1))
+    preds = [r["predicted_step_s"] for r in a["candidates"]]
+    assert preds == sorted(preds)
+    assert a["chosen"]["family"] == a["candidates"][0]["family"]
+    assert a["schema"] == planner.SCHEMA
+
+
+def test_plan_candidates_excludes_serve_and_oversized():
+    names = planner.plan_candidates(8)
+    assert "serve_eval" not in names  # serve prices a different objective
+    assert "dp_sgd" in names and "zero1_sgd" in names
+    # a 1-device "mesh" can't host the model-parallel families
+    assert "tp_vit" not in planner.plan_candidates(1)
+    assert names == sorted(names)
+
+
+def test_applyable_only_restricts_to_train_overrides(dp_report):
+    plan = planner.build_plan(
+        shard_report=dp_report, hbm_budget_bytes=_BIG, applyable_only=True
+    )
+    for row in plan["candidates"]:
+        assert row["applyable"]
+        assert row["family"] in planner.FAMILY_TRAIN_OVERRIDES
+
+
+# -- the HBM refusal matrix (the typed --memory_check path) ------------------
+
+
+def test_hbm_budget_refusal_matrix(dp_report):
+    from tpu_dist.obs import memory as memory_lib
+
+    fams = dp_report["families"]
+    dp_req = fams["dp_sgd"]["hbm"]["static_bytes_per_device"]
+    z1_req = fams["zero1_sgd"]["hbm"]["static_bytes_per_device"]
+    assert z1_req < dp_req  # ZeRO-1 shards the momentum
+
+    # budget between the two (with headroom 0.9): dp refused, zero1 kept
+    split = int(z1_req / 0.9) + 8
+    assert split * 0.9 < dp_req
+    plan = planner.build_plan(shard_report=dp_report, hbm_budget_bytes=split)
+    assert "dp_sgd" in plan["refused"]
+    assert plan["chosen"]["family"] == "zero1_sgd"
+    # the refusal rode the REAL typed path, with its arithmetic recorded
+    why = plan["refused"]["dp_sgd"]
+    assert why["error"].startswith("InfeasibleMemoryError")
+    assert why["required_bytes"] == dp_req
+    assert why["budget_bytes"] == split
+    assert plan["counts"]["refused"] == len(plan["refused"]) >= 1
+
+    # budget below everything: every candidate refused, chosen is None —
+    # counted, never silently dropped
+    none = planner.build_plan(shard_report=dp_report, hbm_budget_bytes=64)
+    assert none["chosen"] is None
+    assert none["candidates"] == []
+    assert set(none["refused"]) == {"dp_sgd", "zero1_sgd", "dp_int8"}
+
+    # and the planner refuses through the SAME callable --memory_check
+    # uses: the typed error, directly
+    with pytest.raises(memory_lib.InfeasibleMemoryError):
+        memory_lib.preflight_check(
+            dp_req, budget_bytes=64, headroom=0.9, action="refuse"
+        )
+
+
+# -- pricing arithmetic (calibration-gauge correction) -----------------------
+
+
+def test_price_candidate_gauge_arithmetic():
+    """The documented model, checked against hand arithmetic:
+    ``max(flops/Fr, bytes/Br) + wire/Br * (1 - overlap)`` with the
+    cost model's 4-significant-digit rounding."""
+    entry = {
+        "hlo": {"bytes": 10**7, "by_kind": {
+            "all-reduce": {"ops": 2, "elems": 100, "bytes": 10**7},
+        }},
+        "cost": {"flops_per_step": 2e9, "bytes_per_step": 1e8},
+        "hbm": {"static_bytes_per_device": 1234},
+        "mesh": "dp8",
+    }
+    gauges = {
+        "cost.calibration_flops_per_s": 1e12,
+        "cost.calibration_bytes_per_s": 1e10,
+        "cost.calibration_overlap_frac": 0.5,
+    }
+    row = planner.price_candidate("dp_sgd", entry, n_devices=8, gauges=gauges)
+    # compute 2e-3 s, memory 1e-2 s (dominates), comm 1e-3 s half-hidden
+    assert row["predicted_step_s"] == pytest.approx(1e-2 + 0.5e-3)
+    assert row["predicted"]["rate_source"] == "calibrated"
+    assert row["wire_bytes"] == 10**7
+    assert row["static_bytes_per_device"] == 1234
+    assert row["priced_inventory"] == {
+        "all-reduce": {"ops": 2, "elems": 100, "bytes": 10**7},
+    }
+    assert row["applyable"]
+
+
+def test_pricing_gauges_defaults_vs_calibrated():
+    g, source = planner.pricing_gauges()
+    assert source == "uncalibrated-defaults"
+    assert g["cost.calibration_flops_per_s"] == pytest.approx(1.0e12)
+    # an explicit measured rate flips the stamp
+    g2, source2 = planner.pricing_gauges(
+        {"cost.calibration_bytes_per_s": 5e9}
+    )
+    assert source2 == "calibrated"
+    assert g2["cost.calibration_bytes_per_s"] == pytest.approx(5e9)
+    # a live published calibration flips it too (and is restored after)
+    from tpu_dist.obs import counters as counters_lib
+
+    counters_lib.set_gauge("cost.calibration_flops_per_s", 3e12)
+    try:
+        g3, source3 = planner.pricing_gauges()
+        assert source3 == "calibrated"
+        assert g3["cost.calibration_flops_per_s"] == pytest.approx(3e12)
+    finally:
+        counters_lib.reset()
+
+
+def test_uncalibrated_defaults_make_cpu_plans_priceable(dp_report):
+    """On CPU emulation chip_peak_flops() is None — without the fixed
+    default rates nothing would price. Every candidate in a defaults
+    plan is priced, and the report SAYS the rates were defaults."""
+    plan = planner.build_plan(shard_report=dp_report, hbm_budget_bytes=_BIG)
+    assert plan["gauge_source"] == "uncalibrated-defaults"
+    assert plan["counts"]["candidates"] == 3
+    for row in plan["candidates"]:
+        assert row["predicted_step_s"] > 0
+
+
+# -- TD118: plan-must-verify + the inject-miscost probe ----------------------
+
+
+@pytest.fixture(scope="module")
+def verified_plan(dp_report):
+    plan = planner.build_plan(
+        shard_report=dp_report, hbm_budget_bytes=_BIG,
+        names=["dp_sgd", "zero1_sgd"],
+    )
+    probe, violations = planner.verify_plan(plan)
+    return plan, probe, violations
+
+
+def test_td118_clean_plan_verifies(verified_plan):
+    plan, probe, violations = verified_plan
+    assert violations == [], [v.format_text() for v in violations]
+    assert probe["verified"] is True
+    assert probe["family"] == plan["chosen"]["family"]
+    assert probe["priced"] == probe["compiled"]
+    assert probe["priced_wire_bytes"] == probe["compiled_wire_bytes"]
+
+
+def test_td118_inject_miscost_must_be_caught(verified_plan):
+    plan, _, _ = verified_plan
+    bad = planner.inject_miscost(plan)
+    # the original is untouched (deep copy)
+    assert bad["chosen"]["wire_bytes"] != plan["chosen"]["wire_bytes"]
+    probe, violations = planner.verify_plan(bad)
+    assert violations, "the TD118 detector is dead"
+    assert probe["verified"] is False
+    assert all(v.rule == "TD118" for v in violations)
+    assert any("wire" in v.message for v in violations)
+    # the violation path names the plan, not a file
+    assert violations[0].path.startswith("<plan:")
+
+
+def test_td118_no_chosen_plan_is_not_verified():
+    probe, violations = planner.verify_plan({"chosen": None})
+    assert violations == []
+    assert probe["verified"] is None
+
+
+# -- TD119: planner-error-tracked --------------------------------------------
+
+
+def test_planner_error_frac_arithmetic():
+    from tpu_dist.obs import costmodel
+
+    assert costmodel.planner_error_frac(1.0, 1.0) == 0.0
+    assert costmodel.planner_error_frac(1.5, 1.0) == pytest.approx(0.5)
+    assert costmodel.planner_error_frac(0.5, 1.0) == pytest.approx(0.5)
+    # unpriceable / unmeasured → None (a skipped gate, never a fake 0)
+    assert costmodel.planner_error_frac(None, 1.0) is None
+    assert costmodel.planner_error_frac(1.0, None) is None
+    assert costmodel.planner_error_frac(0.0, 1.0) is None
+    assert costmodel.planner_error_frac(1.0, -2.0) is None
+
+
+def test_td119_direction_registered_and_gates():
+    from tpu_dist.obs import compare
+
+    assert compare.direction_of("planner_error_frac") == ("lower", 0.02)
+    assert any(m == "planner_error_frac" for m, _, _ in compare.REPORT_METRICS)
+    assert any(f == "planner_error_frac" for f, _, _ in compare.BENCH_FIELDS)
+    # drift growing past threshold+slack REGRESSES...
+    base = {"planner_error_frac": 0.10}
+    cand = {"planner_error_frac": 0.40}
+    res = compare.compare_scalars(base, cand, threshold=0.05)
+    rows = {r["metric"]: r for r in res["rows"]}
+    assert rows["planner_error_frac"]["verdict"] == "REGRESSED"
+    assert res["regressions"] >= 1
+    # ...self-compare is clean...
+    res0 = compare.compare_scalars(base, dict(base), threshold=0.05)
+    assert {r["metric"]: r for r in res0["rows"]}[
+        "planner_error_frac"]["verdict"] == "ok"
+    # ...and SHRINKING drift is an improvement, never flagged
+    res1 = compare.compare_scalars(cand, base, threshold=0.05)
+    assert {r["metric"]: r for r in res1["rows"]}[
+        "planner_error_frac"]["verdict"] == "ok"
+
+
+def test_td119_plan_records_fold_into_summarize_and_scalars():
+    from tpu_dist.obs import compare, summarize
+
+    records = [
+        {"kind": "train_epoch", "schema_version": 12, "epoch": 0,
+         "loss": 2.0, "epoch_time_s": 10.0, "images_per_sec": 100.0},
+        # the fit()-start announcement...
+        {"kind": "plan", "schema_version": 12, "epoch": 0,
+         "family": "zero1_sgd", "mode": "apply", "applied": True,
+         "predicted_step_s": 4.3e-7, "gauge_source": "uncalibrated-defaults"},
+        # ...superseded by the post-profile TD119 drift record
+        {"kind": "plan", "schema_version": 12, "epoch": 0,
+         "family": "zero1_sgd", "mode": "apply",
+         "predicted_step_s": 4.3e-7, "achieved_step_s": 5.0e-7,
+         "planner_error_frac": 0.14},
+    ]
+    report = summarize.summarize(records)
+    assert len(report["plan_records"]) == 2
+    assert report["plan"]["family"] == "zero1_sgd"
+    assert report["plan"]["planner_error_frac"] == pytest.approx(0.14)
+    scal = compare.report_scalars(report)
+    assert scal["planner_error_frac"] == pytest.approx(0.14)
+    # a plan-less log keeps the scalar None (skipped, never faked)
+    plain = summarize.summarize(records[:1])
+    assert plain["plan"] is None
+    assert compare.report_scalars(plain)["planner_error_frac"] is None
+    # the drift line shows up in the text rendering
+    assert "planner_error_frac=0.14" in summarize.format_text(report)
+
+
+# -- plan_report.json round-trip + forward compat ----------------------------
+
+
+def test_plan_report_roundtrip(tmp_path, dp_report):
+    plan = planner.build_plan(shard_report=dp_report, hbm_budget_bytes=_BIG)
+    path = str(tmp_path / "plan_report.json")
+    planner.save_plan_report(plan, path)
+    loaded = planner.load_plan_report(path)
+    assert loaded["schema"] == planner.SCHEMA
+    assert loaded["chosen"]["family"] == plan["chosen"]["family"]
+    assert "load_notes" not in loaded
+
+    # a foreign tag is a typed, loud error
+    bad = dict(plan, schema="shard_report_v1")
+    with open(str(tmp_path / "foreign.json"), "w") as f:
+        json.dump(bad, f)
+    with pytest.raises(PlanReportError, match="not a plan_report"):
+        planner.load_plan_report(str(tmp_path / "foreign.json"))
+
+    # SAME-version candidate missing pricing keys = corruption, not
+    # forward compat: still the hard typed error
+    broken = json.loads(json.dumps(plan))
+    del broken["candidates"][0]["priced_inventory"]
+    with open(str(tmp_path / "broken.json"), "w") as f:
+        json.dump(broken, f)
+    with pytest.raises(PlanReportError, match="missing"):
+        planner.load_plan_report(str(tmp_path / "broken.json"))
+
+
+def test_plan_report_newer_schema_tolerated_with_count(tmp_path, dp_report):
+    """Satellite: a v2 report from a future writer loads — additive
+    fields ignored, candidates missing the v1 pricing keys skipped WITH
+    a count (the summarize KNOWN_KINDS discipline), never a hard error
+    and never a silent drop."""
+    plan = planner.build_plan(shard_report=dp_report, hbm_budget_bytes=_BIG)
+    future = json.loads(json.dumps(plan))
+    future["schema"] = "plan_report_v2"
+    future["some_v2_field"] = {"new": True}
+    # one v2-only candidate this reader can't price
+    future["candidates"].append({"family": "hypothetical_v2_family"})
+    path = str(tmp_path / "future.json")
+    with open(path, "w") as f:
+        json.dump(future, f)
+    loaded = planner.load_plan_report(path)
+    notes = loaded["load_notes"]
+    assert notes["newer_schema"] == "plan_report_v2"
+    assert notes["skipped_count"] == 1
+    assert "hypothetical_v2_family" in notes["skipped_candidates"]
+    # the v1-complete candidates (and the chosen plan) survive
+    assert {c["family"] for c in loaded["candidates"]} == {
+        c["family"] for c in plan["candidates"]
+    }
+    assert loaded["chosen"]["family"] == plan["chosen"]["family"]
+
+
+def test_shard_report_newer_schema_tolerated_with_count(tmp_path, dp_report):
+    """The same forward-compat discipline retrofitted onto
+    load_shard_report: a newer-versioned report keeps its readable
+    families and skips-with-count the ones missing required keys."""
+    future = json.loads(json.dumps(dp_report))
+    future["schema"] = "shard_report_v2"
+    future["families"]["v2_only"] = {"note": "no v1 keys at all"}
+    path = str(tmp_path / "future_shard.json")
+    with open(path, "w") as f:
+        json.dump(future, f)
+    loaded = shardlint.load_shard_report(path)
+    assert "v2_only" not in loaded["families"]
+    assert loaded["load_notes"]["skipped_count"] == 1
+    assert "dp_sgd" in loaded["families"]
+    # same-version missing keys still raise (corruption, not compat) —
+    # pinned by test_shardlint.py::test_shard_report_roundtrip
+
+
+# -- registry pins -----------------------------------------------------------
+
+
+def test_rules_registry_has_td118_td119():
+    assert RULES["TD118"].name == "plan-must-verify"
+    assert RULES["TD119"].name == "planner-error-tracked"
+
+
+def test_family_overrides_pin_against_step_registry():
+    """Every applyable family is a registered shardlint family, every
+    override names a real TrainConfig field, and the bench-side inverse
+    lookup round-trips — a family added to step.py that --auto_shard
+    apply should reach must land in FAMILY_TRAIN_OVERRIDES too."""
+    import dataclasses
+
+    from tpu_dist.config import TrainConfig
+
+    registered = set(shardlint.registered_families())
+    fields = {f.name for f in dataclasses.fields(TrainConfig)}
+    for name, overrides in planner.FAMILY_TRAIN_OVERRIDES.items():
+        assert name in registered, name
+        assert set(overrides) <= fields, (name, overrides)
+        # the overrides construct a valid config
+        cfg = TrainConfig(**overrides)
+        assert planner.family_of(
+            grad_compression=cfg.grad_compression, bf16=cfg.bf16,
+            grad_accu_steps=cfg.grad_accu_steps,
+            shard_weight_update=cfg.shard_weight_update, fsdp=cfg.fsdp,
+        ) == name
+    # an off-registry combo gets an honest None, not a nearest match
+    assert planner.family_of(grad_compression="int8", bf16=True) is None
+    # plan-only families refuse application with the typed KeyError
+    with pytest.raises(KeyError, match="plan-only"):
+        planner.family_train_overrides("tp_vit")
+
+
+# -- the CLI exit contracts --------------------------------------------------
+
+
+def _run_plan_cli(*args, timeout=300):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_dist.analysis", "plan", *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_cli_plan_text_json_and_inject_miscost(tmp_path):
+    """One invocation covers the whole happy-path contract: json format,
+    plan_report written, TD118 verified, the inject-miscost probe caught
+    (exit 0 — a caught probe is the detector working)."""
+    out = str(tmp_path / "plan_report.json")
+    r = _run_plan_cli(
+        "--family", "dp_sgd", "--family", "zero1_sgd",
+        "--format", "json", "--inject-miscost", "--out", out,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    plan = json.loads(r.stdout)
+    assert plan["schema"] == "plan_report_v1"
+    assert plan["verification"]["verified"] is True
+    assert plan["injected_miscost_probe"]["caught"] is True
+    assert plan["injected_miscost_probe"]["violations"]
+    # the written report loads through the schema-pinned loader
+    assert planner.load_plan_report(out)["chosen"]["family"] == (
+        plan["chosen"]["family"]
+    )
+
+
+def test_cli_plan_text_refusal_and_unknown_family(tmp_path):
+    # text format with a budget that refuses the dp family
+    r = _run_plan_cli(
+        "--family", "dp_sgd", "--family", "zero1_sgd",
+        "--hbm_budget_bytes", "3000", "--inject-miscost",
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "REFUSED" in r.stdout
+    assert "InfeasibleMemoryError" in r.stdout
+    assert "chosen zero1_sgd" in r.stdout
+    assert "TD118 verified" in r.stdout
+    # the probe outcome is a visible line, not exit-code-only
+    assert "inject-miscost probe CAUGHT" in r.stdout
+    # an unknown family is exit 2 with the registry named
+    r2 = _run_plan_cli("--family", "nope", timeout=120)
+    assert r2.returncode == 2
+    assert "unknown famil" in r2.stderr
+    # a budget under every candidate: no chosen plan -> nothing proves
+    # the detector alive -> --inject-miscost must exit 2, not pass
+    r3 = _run_plan_cli(
+        "--family", "dp_sgd", "--hbm_budget_bytes", "64",
+        "--inject-miscost",
+    )
+    assert r3.returncode == 2
+    assert "detector is dead" in r3.stderr

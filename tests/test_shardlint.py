@@ -317,8 +317,10 @@ def test_shard_report_roundtrip(tmp_path):
     # planner-facing keys present
     for key in ("collectives", "hbm", "cost", "predicted_step", "verdict"):
         assert key in fam
-    # a wrong schema tag is a typed, loud error
-    bad = dict(loaded, schema="shard_report_v999")
+    # a FOREIGN schema tag is a typed, loud error (a newer
+    # shard_report_vN is tolerated instead — see
+    # test_planner.py::test_shard_report_newer_schema_tolerated_with_count)
+    bad = dict(loaded, schema="plan_report_v1")
     bad_path = str(tmp_path / "bad.json")
     with open(bad_path, "w") as f:
         json.dump(bad, f)

@@ -244,6 +244,28 @@ RULES: dict[str, Rule] = {
             "(tpu_dist/analysis/shardlint.py)",
         ),
         Rule(
+            "TD118",
+            "plan-must-verify",
+            "the --auto_shard planner's chosen plan was priced on a "
+            "collective inventory that does not match what the fresh "
+            "shardlint compile of the same family emits (per-kind "
+            "op/element/byte counts, total wire bytes) — the ranking "
+            "rests on a stale or perturbed cost basis; the "
+            "--inject-miscost probe must be caught or the detector is "
+            "dead (tpu_dist/analysis/planner.py, docs/planner.md)",
+        ),
+        Rule(
+            "TD119",
+            "planner-error-tracked",
+            "after a profiled run, the predicted-vs-achieved step time "
+            "drift (|predicted - achieved| / achieved) must land in "
+            "history as planner_error_frac ('plan' records, schema v12) "
+            "and gate through `obs compare` METRIC_DIRECTIONS (lower is "
+            "better) — planner drift is a regression like any other "
+            "(tpu_dist/analysis/planner.py, obs/compare.py, "
+            "docs/planner.md)",
+        ),
+        Rule(
             "TD104",
             "quantized-wire-bytes-over-budget",
             "gradient-collective payload bytes of a quantized wire format "

@@ -1029,29 +1029,58 @@ _REQUIRED_FAMILY_KEYS = (
     "violations",
 )
 
+SCHEMA_VERSION = 1
+_SCHEMA_TAG_RE = re.compile(r"^shard_report_v(\d+)$")
+
 
 def load_shard_report(path: str) -> dict:
     """Schema-pinned loader — the contract the ``--auto_shard`` planner
-    reads through. Raises :class:`ShardReportError` (never a silent
-    partial dict) on a wrong schema tag or a family entry missing the
-    keys the planner prices with."""
+    reads through — with the summarize ``KNOWN_KINDS`` forward-compat
+    discipline: a NEWER ``shard_report_v<N>`` tag is tolerated (every
+    schema bump is additive) — its extra fields are ignored and any
+    family entry missing the v1 pricing keys is skipped with a count
+    into ``load_notes`` rather than read half-blind. A foreign tag, an
+    older-than-supported version, or a SAME-version entry missing
+    required keys (that is corruption, not forward compat) still raises
+    the typed :class:`ShardReportError` — never a silent partial dict."""
     with open(path, encoding="utf-8") as f:
         data = json.load(f)
-    if not isinstance(data, dict) or data.get("schema") != SCHEMA:
-        got = data.get("schema") if isinstance(data, dict) else type(data).__name__
+    tag = data.get("schema") if isinstance(data, dict) else None
+    m = _SCHEMA_TAG_RE.match(tag) if isinstance(tag, str) else None
+    if not isinstance(data, dict) or not m:
+        got = tag if isinstance(data, dict) else type(data).__name__
         raise ShardReportError(
-            f"{path}: schema {got!r} != {SCHEMA!r} — regenerate with "
-            "`make shard-report`"
+            f"{path}: schema {got!r} is not a shard_report tag — "
+            "regenerate with `make shard-report`"
         )
+    ver = int(m.group(1))
+    if ver < SCHEMA_VERSION:
+        raise ShardReportError(
+            f"{path}: schema {tag!r} predates v{SCHEMA_VERSION} — "
+            "regenerate with `make shard-report`"
+        )
+    newer = ver > SCHEMA_VERSION
     fams = data.get("families")
     if not isinstance(fams, dict):
         raise ShardReportError(f"{path}: no 'families' map")
-    for name, entry in fams.items():
+    skipped: dict = {}
+    for name, entry in list(fams.items()):
         missing = [k for k in _REQUIRED_FAMILY_KEYS if k not in entry]
-        if missing:
+        if not missing:
+            continue
+        if not newer:
             raise ShardReportError(
                 f"{path}: family {name!r} is missing {missing}"
             )
+        skipped[name] = missing
+        del fams[name]
+    if newer:
+        data["load_notes"] = {
+            "newer_schema": tag,
+            "reader_version": SCHEMA_VERSION,
+            "skipped_families": skipped,
+            "skipped_count": len(skipped),
+        }
     return data
 
 

@@ -28,14 +28,20 @@ class TrainConfig:
     grad_accu_steps: int = 1       # distributed_gradient_accumulation.py:26
 
     # -- optimizer / schedule (hard-coded in the reference) -----------------
-    optimizer: str = "sgd"         # sgd (reference, distributed.py:63) | adamw
-    momentum: float = 0.9          # distributed.py:63 (sgd only)
+    optimizer: str = "sgd"         # sgd (reference, distributed.py:63) |
+                                   # adamw | lars | lamb (large-batch
+                                   # trust-ratio recipes, train/optim.py)
+    momentum: float = 0.9          # distributed.py:63 (sgd/lars)
     weight_decay: float = 1e-4     # distributed.py:63
     adamw_decay_mask: str = "auto" # auto: skip rank<=1 leaves | all: decay every leaf
     lr_schedule: str = "multistep" # multistep (reference) | cosine
     lr_milestones: Tuple[int, ...] = (60, 120, 160)  # distributed.py:64
     lr_gamma: float = 0.2          # distributed.py:64
-    warmup_epochs: int = 0         # cosine schedule only
+    warmup_epochs: int = 0         # linear LR warmup epochs (both schedules)
+    lr_base_batch: int = 0         # Goyal linear-scaling rule: when > 0,
+                                   # lr is scaled by batch_size/lr_base_batch
+                                   # (optim.linear_scaled_lr — the
+                                   # large-batch LARS/LAMB recipe)
     label_smoothing: float = 0.0
     grad_clip_norm: float = 0.0    # 0 = off; global-norm clip of reduced grads
 
@@ -212,6 +218,16 @@ class TrainConfig:
                                    # (docs/compression.md)
     sharded_ckpt: bool = False     # per-process shard files + rank-0 manifest;
                                    # no gather at save time (FSDP/ZeRO scale)
+    auto_shard: str = "off"        # off | plan | apply — run the static
+                                   # sharding planner (analysis/planner.py)
+                                   # at startup: enumerate the shardlint
+                                   # family matrix, price each with the
+                                   # calibrated cost model, refuse HBM-
+                                   # infeasible configs through the
+                                   # --memory_check path, print the ranked
+                                   # table. 'apply' additionally rewrites
+                                   # this config to the chosen plan's
+                                   # family (docs/planner.md)
 
     # -- resilience (docs/resilience.md) ------------------------------------
     ckpt_verify: bool = True       # CRC32-verify checkpoints at restore and
@@ -260,9 +276,14 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=d.port)
     p.add_argument("--grad_accu_steps", type=int, default=d.grad_accu_steps,
                    help="gradient accumulation sub-steps (no_sync semantics)")
-    p.add_argument("--optimizer", choices=("sgd", "adamw"), default=d.optimizer,
-                   help="sgd (reference parity) or adamw (decoupled weight "
-                        "decay; the transformer default)")
+    p.add_argument("--optimizer", choices=("sgd", "adamw", "lars", "lamb"),
+                   default=d.optimizer,
+                   help="sgd (reference parity), adamw (decoupled weight "
+                        "decay; the transformer default), or the large-batch "
+                        "trust-ratio recipes: lars (layer-wise adaptive SGD, "
+                        "conv nets at 16k+ batch) and lamb (layer-wise "
+                        "AdamW, BERT-style) — pair with --lr_base_batch and "
+                        "--warmup_epochs")
     p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--weight_decay", type=float, default=d.weight_decay)
     p.add_argument("--adamw_decay_mask", choices=("auto", "all"),
@@ -280,7 +301,13 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--lr_gamma", type=float, default=d.lr_gamma,
                    help="multistep decay factor (reference: 0.2)")
     p.add_argument("--warmup_epochs", type=int, default=d.warmup_epochs,
-                   help="linear warmup epochs (cosine schedule only)")
+                   help="linear LR warmup epochs (cosine and multistep; "
+                        "mandatory half of the large-batch LARS/LAMB recipe)")
+    p.add_argument("--lr_base_batch", type=int, default=d.lr_base_batch,
+                   metavar="B0",
+                   help="Goyal linear-scaling rule: scale --lr by "
+                        "batch_size/B0 (0 = off). The other half of the "
+                        "large-batch recipe")
     p.add_argument("--label_smoothing", type=float, default=d.label_smoothing)
     p.add_argument("--grad_clip_norm", type=float, default=d.grad_clip_norm,
                    help="global-norm gradient clip; 0 disables")
@@ -497,6 +524,17 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="per-device HBM budget override in bytes "
                         "(default: the chip table — "
                         "obs/costmodel.CHIP_HBM_BYTES)")
+    p.add_argument("--auto_shard", choices=("off", "plan", "apply"),
+                   default=d.auto_shard,
+                   help="static sharding planner at startup "
+                        "(analysis/planner.py): enumerate the shardlint "
+                        "family matrix, price each candidate with the "
+                        "calibrated cost model + HLO wire bytes, refuse "
+                        "HBM-infeasible ones through the --memory_check "
+                        "path, and print the ranked plan (also lands in "
+                        "the history as a 'plan' record, TD119-gated). "
+                        "'apply' rewrites this config to the winning "
+                        "family's flags before training (docs/planner.md)")
     p.add_argument("--per_host_log", action="store_true",
                    help="every process writes its own JSONL history "
                         "(<log_file>.h<rank>; rank 0 keeps the bare path) "

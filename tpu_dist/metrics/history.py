@@ -45,7 +45,12 @@ waterfall, a live-buffer census reconciled against the allocator so
 attributed + unattributed == bytes_in_use exactly; ``event: "oom"``
 records carry a parsed RESOURCE_EXHAUSTED report plus the ledger
 snapshot live at the crash — ``obs/memory.py``, docs/observability.md
-"HBM ledger & OOM forensics")
+"HBM ledger & OOM forensics"); v12 added the planner layer — the
+``plan`` kind (the ``--auto_shard`` plan chosen at fit() start: family,
+mode, predicted step time, gauge source; after a profiled run a second
+``plan`` record lands with the achieved step time and the TD119
+``planner_error_frac`` drift scalar — ``tpu_dist/analysis/planner.py``,
+docs/planner.md)
 (docs/observability.md). Consumers (``obs summarize``/``compare``) read
 all versions: every addition is a new kind or optional field, never a
 changed one, and readers skip-with-count kinds they don't know — so a
@@ -68,13 +73,13 @@ import jax
 
 from tpu_dist.obs import counters as counters_lib
 
-SCHEMA_VERSION = 11  # v11 (additive): 'memory' HBM-ledger records (static
-#                      per-leaf accounting, memory_analysis waterfall,
-#                      census/allocator reconciliation, OOM events —
-#                      tpu_dist/obs/memory.py); v10 added 'serve'
-#                      serving-SLO windows; v9 'postmortem' crash
-#                      bundles; v8 'fleet' scheduler decisions; v7
-#                      'resume' segment boundaries
+SCHEMA_VERSION = 12  # v12 (additive): 'plan' records — the --auto_shard
+#                      chosen plan + TD119 predicted-vs-achieved
+#                      planner_error_frac (tpu_dist/analysis/planner.py);
+#                      v11 added 'memory' HBM-ledger records
+#                      (tpu_dist/obs/memory.py); v10 'serve' serving-SLO
+#                      windows; v9 'postmortem' crash bundles; v8 'fleet'
+#                      scheduler decisions; v7 'resume' segment boundaries
 
 
 class MetricsHistory:

@@ -570,6 +570,25 @@ def predicted_step_time(
     return out
 
 
+def planner_error_frac(
+    predicted_s: Optional[float], achieved_s: Optional[float],
+) -> Optional[float]:
+    """The TD119 drift scalar: ``|predicted - achieved| / achieved`` of
+    one step's wall time — how far the ``--auto_shard`` planner's priced
+    step time sits from what the hardware measured. Lands in history as
+    ``planner_error_frac`` (``plan`` records, schema v12) and gates
+    through ``obs compare`` METRIC_DIRECTIONS (lower is better), so a
+    cost-model regression fails CI like a throughput one. None — a
+    skipped gate row, never a fake zero — when either side is missing
+    or non-positive."""
+    if (
+        not isinstance(predicted_s, (int, float)) or predicted_s <= 0
+        or not isinstance(achieved_s, (int, float)) or achieved_s <= 0
+    ):
+        return None
+    return round(abs(float(predicted_s) - float(achieved_s)) / float(achieved_s), 4)
+
+
 def publish(cost: Optional[dict]) -> None:
     """Stamp a step-cost dict into the telemetry gauges
     (``device.flops_per_step`` / ``device.bytes_per_step``) so every

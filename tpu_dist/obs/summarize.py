@@ -30,7 +30,7 @@ from tpu_dist.obs import memory as memory_lib
 #: kinds summarized; their unknown kinds are skipped with a count — the
 #: forward-compat contract that lets v3 tooling read v4 logs and vice
 #: versa (every schema bump is additive).
-SUPPORTED_SCHEMA = 11
+SUPPORTED_SCHEMA = 12
 
 #: Record kinds this reader folds into the report. Anything else is
 #: counted into ``skipped_kinds`` — never an error, never silent.
@@ -38,7 +38,7 @@ KNOWN_KINDS = frozenset((
     "train_epoch", "eval", "straggler", "anomaly", "device_stats",
     "auto_recover", "spans", "goodput", "profile", "alert",
     "profile_analysis", "resume", "fleet", "postmortem", "serve",
-    "memory",
+    "memory", "plan",
 ))
 
 
@@ -83,6 +83,7 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
     serve_events: List[dict] = []   # serving events (mid-serve retraces)
     memory_records: List[dict] = []  # HBM-ledger snapshots (schema v11)
     oom_events: List[dict] = []      # parsed RESOURCE_EXHAUSTED crashes
+    plan_records: List[dict] = []    # --auto_shard plan / TD119 drift (v12)
     dstats: dict = {}  # epoch -> per-epoch device_stats aggregate
     recoveries = 0
     prev_counters: Optional[dict] = None
@@ -229,6 +230,19 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
                               "reconciliation", "allocator", "feasibility")
                     if rec.get(k) is not None
                 })
+        elif kind == "plan":
+            # an --auto_shard plan (schema v12, analysis/planner.py):
+            # the chosen family + its priced step time at fit() start,
+            # and — after a profiled run — the TD119 predicted-vs-
+            # achieved drift record
+            plan_records.append({
+                k: rec.get(k)
+                for k in ("epoch", "family", "mode", "applied",
+                          "predicted_step_s", "achieved_step_s",
+                          "planner_error_frac", "gauge_source",
+                          "n_candidates", "n_refused")
+                if rec.get(k) is not None
+            })
         elif kind == "profile":
             profiles.append({
                 k: rec.get(k)
@@ -341,6 +355,20 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
             {"peak_hbm_bytes": peak_hbm, "oom_events": len(oom_events)}
             if (peak_hbm is not None or oom_events or memory_records)
             else None
+        ),
+        "plan_records": plan_records,
+        "plan": (
+            # the gating view of the planner layer: the last plan record
+            # wins (the post-profile TD119 drift record supersedes the
+            # fit()-start announcement, which carries no achieved time)
+            {
+                k: plan_records[-1].get(k)
+                for k in ("family", "mode", "applied", "predicted_step_s",
+                          "achieved_step_s", "planner_error_frac",
+                          "gauge_source")
+                if plan_records[-1].get(k) is not None
+            }
+            if plan_records else None
         ),
         "stragglers": stragglers,
         "anomalies": anomalies,
@@ -603,6 +631,21 @@ def format_text(report: dict) -> str:
             f"peak HBM: {memory_lib.fmt_bytes(mem['peak_hbm_bytes'])} "
             "(worst chip — the compare gate's memory scalar)"
         )
+    plan = report.get("plan")
+    if plan:
+        bits = [f"plan: {plan.get('family', '?')}"]
+        if plan.get("mode"):
+            bits.append(f"mode={plan['mode']}")
+        if plan.get("predicted_step_s") is not None:
+            bits.append(f"predicted {plan['predicted_step_s'] * 1e3:.3g} ms/step")
+        if plan.get("achieved_step_s") is not None:
+            bits.append(f"achieved {plan['achieved_step_s'] * 1e3:.3g} ms/step")
+        if plan.get("planner_error_frac") is not None:
+            bits.append(
+                f"planner_error_frac={plan['planner_error_frac']:.4f}"
+                " (TD119 — the compare gate's planner scalar)"
+            )
+        lines.append("  ".join(bits))
     gp_epochs = report.get("goodput_epochs") or []
     if gp_epochs:
         lines.append("goodput (seconds per window):")

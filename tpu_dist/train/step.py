@@ -63,14 +63,17 @@ GRAD_COMPRESSION_MODES = ("none", "bf16", "int8", "int8_ef")
 # ONE registry of the shard-auditable parallelism config families: name →
 # the :func:`make_train_step` kwargs that select the family. This is the
 # enumeration the static analyzers walk (the jaxpr audit's budget cases,
-# the shardlint HLO audit — tpu_dist/analysis) and the search space a
-# measurement-calibrated ``--auto_shard`` planner ranks over (ROADMAP
-# item 3): every entry lowers to a distinct collective inventory, and
-# each gets its own verified entry in ``shard_report.json``
+# the shardlint HLO audit — tpu_dist/analysis) and the search space the
+# measurement-calibrated ``--auto_shard`` planner ranks over
+# (``analysis/planner.py``): every entry lowers to a distinct collective
+# inventory, and each gets its own verified entry in ``shard_report.json``
 # (docs/shard_report.md). Families that need a model/mesh beyond the flag
 # combo (fsdp's per-leaf specs, tp's param_specs, sp's ring-attention
 # model) carry the axis flags here and get their builders in
-# ``analysis/shardlint.py``.
+# ``analysis/shardlint.py``. The planner's TRAINER-flag projection of
+# these step kwargs lives in ``planner.FAMILY_TRAIN_OVERRIDES`` — a new
+# family here that --auto_shard apply should reach needs an entry there
+# too (test_planner pins the two registries against each other).
 SHARD_CONFIG_FAMILIES: dict = {
     "dp_sgd": {},
     "dp_sgd_accum4": {"grad_accum_steps": 4},

@@ -156,6 +156,14 @@ class Trainer:
             num_processes=cfg.num_processes,
             process_id=cfg.process_id,
         )
+        # --auto_shard: run the static sharding planner BEFORE this config
+        # is consumed — 'apply' rewrites cfg to the chosen plan's family,
+        # and the rewritten config then flows through every validation and
+        # the real-model HBM preflight below like a hand-written one
+        self._plan = None
+        if cfg.auto_shard != "off":
+            cfg = self._run_auto_shard(cfg, mesh)
+            self.cfg = cfg
         if cfg.ckpt_io_retries < 0:
             raise ValueError(
                 f"ckpt_io_retries must be >= 0, got {cfg.ckpt_io_retries}"
@@ -677,8 +685,37 @@ class Trainer:
                 weight_decay=cfg.weight_decay,
                 fused=cfg.fused_optimizer,
             )
+        elif cfg.optimizer in ("lars", "lamb"):
+            if cfg.fused_optimizer:
+                raise ValueError(
+                    "fused_optimizer is the Pallas fused-SGD kernel; "
+                    f"{cfg.optimizer} uses the plain (XLA-fused) update"
+                )
+            if cfg.shard_weight_update:
+                raise ValueError(
+                    f"{cfg.optimizer} needs per-layer norms, which the "
+                    "ZeRO-1 flat layout destroys — use --fsdp (leaf-"
+                    "grained sharding) for a sharded large-batch run"
+                )
+            from tpu_dist.train.optim import LAMB, LARS  # noqa: PLC0415
+
+            if cfg.optimizer == "lars":
+                self.optimizer = LARS(
+                    momentum=cfg.momentum, weight_decay=cfg.weight_decay
+                )
+            else:
+                self.optimizer = LAMB(weight_decay=cfg.weight_decay)
+            if cfg.lr_base_batch <= 0 or cfg.warmup_epochs <= 0:
+                rank0_print(
+                    f"=> WARNING: {cfg.optimizer} without the full "
+                    "large-batch recipe (--lr_base_batch for linear LR "
+                    "scaling + --warmup_epochs) — trust ratios alone "
+                    "rarely save an unscaled schedule"
+                )
         else:
-            raise ValueError(f"unknown optimizer {cfg.optimizer!r} (sgd | adamw)")
+            raise ValueError(
+                f"unknown optimizer {cfg.optimizer!r} (sgd | adamw | lars | lamb)"
+            )
         params, bn_state = self.model.init(jax.random.PRNGKey(seed))
         state = TrainState.create(params, bn_state, self.optimizer)
         if cfg.grad_compression == "int8_ef" and not cfg.fsdp:
@@ -773,10 +810,24 @@ class Trainer:
         self._lr_scale = 1.0
         self._state_poisoned = False
         self._best_top1 = -1.0
+        base_lr = cfg.lr
+        if cfg.lr_base_batch > 0:
+            # Goyal linear-scaling rule — the large-batch recipe's first
+            # half; the second half is the warmup ramp below
+            from tpu_dist.train.optim import linear_scaled_lr  # noqa: PLC0415
+
+            base_lr = linear_scaled_lr(cfg.lr, cfg.lr_base_batch, cfg.batch_size)
+            rank0_print(
+                f"=> linear LR scaling: {cfg.lr} x {cfg.batch_size}/"
+                f"{cfg.lr_base_batch} = {base_lr:g}"
+            )
         if cfg.lr_schedule == "cosine":
-            self.lr_schedule = cosine_lr(cfg.lr, cfg.epochs, cfg.warmup_epochs)
+            self.lr_schedule = cosine_lr(base_lr, cfg.epochs, cfg.warmup_epochs)
         else:
-            self.lr_schedule = multistep_lr(cfg.lr, cfg.lr_milestones, cfg.lr_gamma)
+            self.lr_schedule = multistep_lr(
+                base_lr, cfg.lr_milestones, cfg.lr_gamma,
+                warmup_epochs=cfg.warmup_epochs,
+            )
 
         compute_dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
         if cfg.fsdp:
@@ -1004,6 +1055,82 @@ class Trainer:
         if self.cfg.steps_per_epoch is not None:
             n = min(n, self.cfg.steps_per_epoch)
         self._global_step = self.start_epoch * n + self._resume_step
+
+    def _run_auto_shard(self, cfg: TrainConfig, mesh) -> TrainConfig:
+        """``--auto_shard``: enumerate/price/filter the shardlint family
+        matrix (analysis/planner.py) and print the ranked plan. ``apply``
+        rewrites the returned config to the chosen family's flags — the
+        rewritten config then passes through every downstream validation
+        and the real-model HBM preflight exactly like a hand-written one.
+
+        The chosen plan is TD118-verified here (fresh compile of the
+        chosen family, inventory must match the priced one) — an
+        unverifiable plan is refused in ``apply`` mode, warned in ``plan``
+        mode. The plan lands in the history as a ``plan`` record (schema
+        v12) at fit() start, and TD119 closes the loop after a profiled
+        run (``_note_capture_analysis``)."""
+        import dataclasses  # noqa: PLC0415
+
+        from tpu_dist.analysis import planner  # noqa: PLC0415
+        from tpu_dist.obs import memory as memory_lib  # noqa: PLC0415
+
+        apply = cfg.auto_shard == "apply"
+        if apply and (cfg.sp > 1 or cfg.tp > 1 or cfg.ep > 1 or cfg.pp > 1):
+            raise ValueError(
+                "--auto_shard apply plans over the flat data-parallel "
+                "family matrix and would clobber an explicit sp/tp/ep/pp "
+                "layout — use --auto_shard plan for an advisory table"
+            )
+        plan = planner.build_plan(
+            mesh=mesh,
+            hbm_budget_bytes=cfg.hbm_budget_bytes,
+            memory_headroom=cfg.memory_headroom,
+            applyable_only=apply,
+        )
+        chosen = plan.get("chosen")
+        if chosen is None:
+            rank0_print(planner.format_text(plan))
+            if plan["counts"]["refused"]:
+                raise memory_lib.InfeasibleMemoryError(
+                    f"--auto_shard: all {plan['counts']['refused']} "
+                    "candidate(s) exceed the per-chip HBM budget — shrink "
+                    "the batch, raise --memory_headroom, or widen the mesh"
+                )
+            raise ValueError(
+                "--auto_shard: no candidate could be planned "
+                f"(skipped: {plan.get('skips')})"
+            )
+        probe, violations = planner.verify_plan(plan, mesh=mesh)
+        plan["verification"] = probe
+        rank0_print(planner.format_text(plan))
+        if violations:
+            for v in violations:
+                rank0_print(f"=> {v}")
+            if apply:
+                raise ValueError(
+                    "--auto_shard apply: the chosen plan failed TD118 "
+                    "plan-must-verify (compiled collective inventory != "
+                    "priced inventory) — refusing to train on a mispriced "
+                    "ranking"
+                )
+        self._plan = {
+            "family": chosen["family"],
+            "mode": cfg.auto_shard,
+            "applied": apply,
+            "predicted_step_s": chosen.get("predicted_step_s"),
+            "gauge_source": plan.get("gauge_source"),
+            "n_candidates": plan["counts"]["candidates"],
+            "n_refused": plan["counts"]["refused"],
+        }
+        if not apply:
+            return cfg
+        overrides = planner.family_train_overrides(chosen["family"])
+        rank0_print(
+            f"=> auto_shard apply: {chosen['family']} -> "
+            + (", ".join(f"{k}={v}" for k, v in sorted(overrides.items()))
+               or "reference flags")
+        )
+        return dataclasses.replace(cfg, **overrides)
 
     def _ckpt_io(self):
         """Sync module functions, the sharded writer (``--sharded_ckpt``),
@@ -2020,6 +2147,42 @@ class Trainer:
                 "profile_analysis", epoch=epoch, reason=reason,
                 dir=capture_dir, **rec,
             )
+        # TD119 planner-error-tracked: every profiled run closes the
+        # planner's loop — the capture's achieved per-step wall time
+        # against the priced one. An --auto_shard plan is held to the
+        # step time it promised; without one, this run's own compiled
+        # cost is priced with the calibration just published, so the
+        # drift gauge exists for every profiled run, planned or not.
+        busy = analysis.get("device_busy_s")
+        if steps and isinstance(busy, (int, float)) and busy > 0:
+            n_dev = max(jax.local_device_count(), 1)
+            achieved = busy / steps / n_dev
+            predicted = (self._plan or {}).get("predicted_step_s")
+            src = "plan"
+            if predicted is None and self._step_cost:
+                pred = costmodel_lib.predicted_step_time(
+                    self._step_cost, n_devices=n_dev,
+                )
+                predicted = pred.get("predicted_step_s") if pred else None
+                src = "step_cost"
+            err = costmodel_lib.planner_error_frac(predicted, achieved)
+            if err is not None:
+                counters_lib.set_gauge("plan.planner_error_frac", err)
+                rank0_print(
+                    f"=> planner drift (TD119): predicted {predicted:g}s "
+                    f"vs achieved {achieved:g}s per step — "
+                    f"planner_error_frac={err:.4f} [{src}]"
+                )
+                if self._history is not None:
+                    self._history.log(
+                        "plan", epoch=epoch,
+                        family=(self._plan or {}).get("family"),
+                        mode=(self._plan or {}).get("mode"),
+                        predicted_step_s=predicted,
+                        achieved_step_s=float(f"{achieved:.4g}"),
+                        planner_error_frac=err,
+                        prediction_source=src,
+                    )
 
     def _apply_step_faults(self, epoch: int, step: int, lr: float) -> None:
         """Host-side --fault_plan actions at the step grain. A matching
@@ -2449,6 +2612,18 @@ class Trainer:
                 counters_lib.set_gauge(
                     "comm.grad_wire_bytes_per_step", 2 * bpe * n_params
                 )
+        if self._plan is not None:
+            # the --auto_shard announcement record (schema v12): what the
+            # planner chose and what step time it promised. TD119's drift
+            # record lands later, from _note_capture_analysis, once a
+            # profiled run produces an achieved step time to compare
+            if telemetry:
+                counters_lib.set_gauge("plan.family", self._plan["family"])
+                if self._plan.get("predicted_step_s") is not None:
+                    counters_lib.set_gauge(
+                        "plan.predicted_step_s", self._plan["predicted_step_s"]
+                    )
+            history.log("plan", epoch=self.start_epoch, **self._plan)
         if cfg.heartbeat_file:
             from tpu_dist.obs.heartbeat import (  # noqa: PLC0415
                 Heartbeat, per_rank_path,
