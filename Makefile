@@ -1,6 +1,6 @@
 # Convenience targets; CI / the driver call the underlying commands directly.
 
-.PHONY: test quick bench csrc clean lint shard-report plan-report pod-report monitor profile-report elastic-drill fleet-drill postmortem-drill serve-drill serve-report memory-report
+.PHONY: test quick bench csrc clean lint shard-report plan-report tune-overlap ckpt-bench pod-report monitor profile-report elastic-drill fleet-drill postmortem-drill serve-drill serve-report memory-report
 
 csrc:
 	$(MAKE) -C tpu_dist/csrc
@@ -32,6 +32,27 @@ shard-report:
 #   make plan-report [OUT=plan_report.json]
 plan-report:
 	python -m tpu_dist.analysis plan --inject-miscost --out $(or $(OUT),plan_report.json)
+
+# Layer 5 — the comm/compute overlap autotuner: compile every knob
+# candidate per config family, require payload-byte identity while the
+# HLO collective schedule actually moves (TD121 — incl. the injected
+# payload-perturbed probe that must be caught, exit 2 if the detector
+# went dead), and write the schema-pinned tune_report.json that
+# `plan --tune-report` and the trainer's `--tune_report` consume
+# (docs/analysis.md "Layer 5"):
+#   make tune-overlap [OUT=tune_report.json]
+tune-overlap:
+	python -m tpu_dist.analysis tune-overlap --inject-payload --out $(or $(OUT),tune_report.json)
+
+# The async-checkpoint cost proof: measure step-loop blocking per
+# sharded save for the synchronous barrier path vs the
+# snapshot-then-write background path on the same model, print the
+# ratio (acceptance floor: >=5x less blocking), and keep the TD120
+# injected-EIO probe honest — a probe that comes back clean is a dead
+# detector: exit 2 (docs/checkpointing.md "The cost, measured"):
+#   make ckpt-bench
+ckpt-bench:
+	python bench.py --ckpt sweep --config resnet18_cifar100_fp32 --batch_size 64 --warmup 1
 
 # <5-min cross-component slice (see tests/conftest.py for the curated set)
 quick:

@@ -715,6 +715,145 @@ def run_pp(cfg: BenchConfig, steps: int, warmup: int, pp: int,
     })
 
 
+def _build_model(cfg: BenchConfig):
+    from tpu_dist.nn import resnet18, resnet34, resnet50
+    from tpu_dist.nn.resnet import resnet50_imagenet
+    from tpu_dist.nn.vit import vit_b16
+
+    builders = {
+        "resnet18": resnet18, "resnet34": resnet34, "resnet50": resnet50,
+        "resnet50_imagenet": lambda num_classes: resnet50_imagenet(
+            num_classes, s2d_stem=cfg.s2d
+        ),
+        "vit_b16": lambda num_classes: vit_b16(num_classes, cfg.image_size),
+    }
+    return builders[cfg.model](num_classes=cfg.num_classes)
+
+
+def run_ckpt(cfg: BenchConfig, warmup: int, mode: str, saves: int = 6) -> dict:
+    """Sharded-checkpoint drill (``--ckpt``): how long does the STEP LOOP
+    stay blocked per save?  ``sync`` pays uncommit + device→host snapshot
+    + serialize + CRC32 + write + manifest commit inline;  ``async`` pays
+    only uncommit + snapshot — the rest runs on the writer thread
+    (``ckpt/checkpoint.py`` two-phase protocol).  A real compiled train
+    step runs between saves so the async writer has compute to hide
+    behind, and the drill proves the hidden work still happened: the
+    drain is bounded-waited, the newest manifest is deep-verified
+    (CRC32), and on the async path an injected EIO (``--fault_plan``
+    ladder) MUST surface through the drain — the TD120 CLI probe; the
+    caller exits 2 when ``ckpt_eio_probe`` comes back dead."""
+    t_bench0 = time.perf_counter()
+    import os  # noqa: PLC0415
+    import shutil  # noqa: PLC0415
+    import tempfile  # noqa: PLC0415
+
+    import jax  # noqa: PLC0415
+    import jax.numpy as jnp  # noqa: PLC0415
+
+    from tpu_dist.ckpt import checkpoint as ckpt  # noqa: PLC0415
+    from tpu_dist.comm import mesh as mesh_lib  # noqa: PLC0415
+    from tpu_dist.resilience import faults  # noqa: PLC0415
+    from tpu_dist.train.optim import SGD  # noqa: PLC0415
+    from tpu_dist.train.state import TrainState  # noqa: PLC0415
+    from tpu_dist.train.step import make_train_step  # noqa: PLC0415
+
+    assert mode in ("sync", "async"), mode
+    mesh = mesh_lib.data_parallel_mesh()
+    n_dev = int(mesh.devices.size)
+    batch = max(n_dev, (cfg.global_batch // n_dev) * n_dev)
+
+    model = _build_model(cfg)
+    optimizer = SGD(momentum=0.9, weight_decay=1e-4)
+    params, bn_state = model.init(jax.random.PRNGKey(0))
+    state = jax.device_put(
+        TrainState.create(params, bn_state, optimizer), mesh_lib.replicated(mesh)
+    )
+    step = make_train_step(
+        model.apply, optimizer, mesh, sync_bn=False,
+        compute_dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
+    )
+    rng = np.random.default_rng(0)
+    images = mesh_lib.shard_batch(
+        mesh,
+        rng.normal(size=(batch, cfg.image_size, cfg.image_size, 3)).astype(np.float32),
+    )
+    labels = mesh_lib.shard_batch(
+        mesh, rng.integers(0, cfg.num_classes, batch).astype(np.int32)
+    )
+    for _ in range(max(1, warmup)):
+        state, _metrics = step(state, images, labels, 0.1)
+    jax.block_until_ready(state.params)
+    snap_bytes = ckpt.snapshot_sharded(state, 0).nbytes
+
+    ckpt_dir = tempfile.mkdtemp(prefix=f"ckpt_bench_{mode}_")
+    writer = ckpt.AsyncShardedCheckpointer() if mode == "async" else None
+    blocked: list = []
+    try:
+        for i in range(saves):
+            state, _metrics = step(state, images, labels, 0.1)
+            jax.block_until_ready(state.params)
+            # step boundary reached: from here to t1 is PURE save blocking
+            t0 = time.perf_counter()
+            if writer is None:
+                ckpt.save_sharded(ckpt_dir, state, epoch=i)
+            else:
+                writer.save(ckpt_dir, state, epoch=i)
+            blocked.append(time.perf_counter() - t0)
+        t_drain0 = time.perf_counter()
+        if writer is not None and not writer.close(timeout=600.0):
+            raise RuntimeError("ckpt drill: async writer failed to drain")
+        drain_ms = round(1000 * (time.perf_counter() - t_drain0), 3)
+
+        latest = ckpt.latest_sharded_checkpoint(ckpt_dir)
+        if latest is None or latest[1] != saves - 1:
+            raise RuntimeError(
+                f"ckpt drill: expected committed epoch {saves - 1}, "
+                f"found {latest!r}"
+            )
+        ckpt.verify_sharded(latest[0], deep=True)  # raises on corruption
+
+        eio_probe = None
+        if mode == "async":
+            # TD120 probe: arm an EIO on the next shard write and prove the
+            # background error SURFACES at the drain — a clean probe means
+            # async writes could silently lose checkpoints.
+            probe_dir = os.path.join(ckpt_dir, "eio_probe")
+            faults.configure("ckpt_write@call=1")
+            probe_writer = ckpt.AsyncShardedCheckpointer()
+            try:
+                probe_writer.save(probe_dir, state, epoch=saves)
+                probe_writer.wait(timeout=600.0)
+                eio_probe = "dead"
+            except OSError:
+                eio_probe = "caught"
+            finally:
+                faults.clear()
+                try:
+                    probe_writer.close(timeout=60.0)
+                except OSError:
+                    pass  # the probe's own injected error draining out
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    out = {
+        # no "value": blocked ms is lower-is-better; compare gates the
+        # registry-declared ckpt_blocked_ms field instead (obs/compare.py)
+        "metric": f"sharded_ckpt_{mode}",
+        "unit": "ms blocked per save",
+        "ckpt_mode": mode,
+        "ckpt_blocked_ms": round(1000 * sum(blocked) / len(blocked), 3),
+        "ckpt_blocked_ms_max": round(1000 * max(blocked), 3),
+        "ckpt_saves": saves,
+        "ckpt_snapshot_bytes": int(snap_bytes),
+        "n_devices": n_dev,
+        "wall_s": round(time.perf_counter() - t_bench0, 2),
+    }
+    if mode == "async":
+        out["ckpt_drain_ms"] = drain_ms
+        out["ckpt_eio_probe"] = eio_probe
+    return _stamped(out)
+
+
 def _guarded_backend_init(timeout_s: float, default_invocation: bool = False) -> None:
     """Fail loudly (exit 3) if device discovery hangs — a wedged TPU tunnel
     must not hang the calling harness forever.
@@ -943,6 +1082,17 @@ def main() -> None:
              "CPU emulation) alongside measured throughput",
     )
     p.add_argument(
+        "--ckpt",
+        choices=("off", "sync", "async", "sweep"),
+        default="off",
+        help="sharded-checkpoint drill: measure step-loop blocking time "
+             "per save (ckpt_blocked_ms) for the synchronous vs the "
+             "snapshot-then-write (--async_ckpt) composition; 'sweep' runs "
+             "both, prints the blocking ratio, and exits 2 if the "
+             "injected-EIO probe through the async drain comes back dead "
+             "(the TD120 CLI gate)",
+    )
+    p.add_argument(
         "--serve", action="store_true",
         help="serving micro-bench: drive the continuous-batching engine "
              "(tpu_dist/serve) with bursty arrivals and emit "
@@ -995,11 +1145,38 @@ def main() -> None:
         default_invocation=(
             args.config == "resnet18_cifar100"
             and args.grad_compression == "none"
+            and args.ckpt == "off"
             and not (args.all or args.table or args.scaling or args.pp
                      or args.attn or args.attn_all or args.profile_dir
                      or args.serve)
         ),
     )
+    if args.ckpt != "off" and not args.table:
+        import sys
+
+        modes = ("sync", "async") if args.ckpt == "sweep" else (args.ckpt,)
+        recs = {}
+        for m in modes:
+            recs[m] = run_ckpt(CONFIGS[args.config], args.warmup, m)
+            print(json.dumps(recs[m]), flush=True)
+        if args.ckpt == "sweep":
+            ratio = recs["sync"]["ckpt_blocked_ms"] / max(
+                recs["async"]["ckpt_blocked_ms"], 1e-9
+            )
+            print(json.dumps(_stamped({
+                "metric": "sharded_ckpt_blocking_ratio",
+                "value": round(ratio, 2),
+                "unit": "x (sync blocked / async blocked)",
+            })), flush=True)
+        dead = [m for m, r in recs.items() if r.get("ckpt_eio_probe") == "dead"]
+        if dead:
+            print(
+                "bench --ckpt: injected EIO came back CLEAN through the "
+                "async drain — the TD120 fault detector is dead",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        return
     if args.serve:
         print(json.dumps(run_serve(
             CONFIGS[args.config], args.serve_requests,
@@ -1034,8 +1211,8 @@ def main() -> None:
         from tpu_dist.obs.memory import fmt_bytes
 
         print("| mode | sec/epoch | images/sec | MFU | goodput | peak HBM "
-              "| vs 4x2080Ti DDP+apex |")
-        print("|---|---|---|---|---|---|---|")
+              "| ckpt blocked/save | vs 4x2080Ti DDP+apex |")
+        print("|---|---|---|---|---|---|---|---|")
         for label, name in rows:
             out = run(CONFIGS[name], args.steps, args.warmup)
             mfu = out.get("mfu")
@@ -1044,11 +1221,26 @@ def main() -> None:
             # already in every bench record; CPU-valid, so the memory
             # column gates even while the TPU tunnel is down
             hbm = out.get("peak_hbm_bytes")
+            # checkpoint-blocking column: a short sharded-save drill per
+            # row when --ckpt is given ('sweep' shows sync→async, the
+            # two-phase protocol's before/after); 'n/a' keeps the default
+            # table invocation's cost unchanged
+            if args.ckpt == "off":
+                ck = "n/a"
+            elif args.ckpt == "sweep":
+                cs = run_ckpt(CONFIGS[name], 2, "sync", saves=3)
+                ca = run_ckpt(CONFIGS[name], 2, "async", saves=3)
+                ck = (f"{cs['ckpt_blocked_ms']:.0f}→"
+                      f"{ca['ckpt_blocked_ms']:.0f} ms")
+            else:
+                cr = run_ckpt(CONFIGS[name], 2, args.ckpt, saves=3)
+                ck = f"{cr['ckpt_blocked_ms']:.0f} ms ({args.ckpt})"
             print(
                 f"| {label} | {out['sec_per_epoch']} | {out['value']} "
                 f"| {f'{mfu:.1%}' if mfu is not None else 'n/a'} "
                 f"| {f'{gp:.1%}' if gp is not None else 'n/a'} "
                 f"| {fmt_bytes(hbm) if hbm is not None else 'n/a'} "
+                f"| {ck} "
                 f"| {out['vs_baseline']}x |"
             )
         return

@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 
 from tpu_dist.comm import mesh as mesh_lib
 from tpu_dist.config import TrainConfig
@@ -59,6 +60,7 @@ def test_dp_tp_sp_training_matches_single_device():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5)
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 17): gates in analysis.yml
 def test_trainer_3d_e2e():
     cfg = TrainConfig(
         dataset="synthetic", model="vit_tiny", num_classes=10, batch_size=16,

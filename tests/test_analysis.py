@@ -452,6 +452,9 @@ def test_clean_realistic_module():
     assert vs == []
 
 
+@pytest.mark.quick  # the quick-slice analysis representative: pure-AST,
+# no subprocess/jaxpr compile (test_cli_clean_on_repo moved to slow,
+# ISSUE 17 tier-1 budget)
 def test_repo_is_lint_clean():
     vs = lint_paths([os.path.join(REPO, "tpu_dist")], root=REPO)
     assert vs == [], "\n".join(v.format_text() for v in vs)
@@ -512,6 +515,7 @@ def test_scan_body_collectives_count_per_trip():
     assert counts["collectives"]["psum"] == 3, counts
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 17): gates in analysis.yml
 def test_audit_all_clean_and_budget_mismatch_detected():
     report, violations = audit_all()
     assert violations == []
@@ -559,7 +563,7 @@ def test_cli_nonzero_on_planted_violation(tmp_path):
     assert {v["rule"] for v in out["violations"]} == {"TD002", "TD004", "TD007"}
 
 
-@pytest.mark.quick
+@pytest.mark.slow  # tier-1 budget (ISSUE 17): gates in analysis.yml
 def test_cli_clean_on_repo():
     # the acceptance gate: lint + jaxpr audit over the real package, exit 0
     r = _run_cli(["--format", "json"])

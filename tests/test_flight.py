@@ -121,6 +121,7 @@ def test_ring_torn_slot_flagged_never_raises(tmp_path):
     assert dec2["header"] is None and dec2["torn_header"]
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 17): gates in analysis.yml
 def test_sigkill_mid_ring_write_recovers_complete_slots(tmp_path):
     """The satellite acceptance: a writer SIGKILLed mid-stream leaves a
     ring whose COMPLETE slots all decode and whose torn tail is at most
@@ -508,7 +509,7 @@ def test_postmortem_schema_literal_pinned_to_history():
     track the real schema — this pin is the drift alarm."""
     from tpu_dist.metrics.history import SCHEMA_VERSION
 
-    assert postmortem_lib.POSTMORTEM_SCHEMA_VERSION == SCHEMA_VERSION == 12
+    assert postmortem_lib.POSTMORTEM_SCHEMA_VERSION == SCHEMA_VERSION == 13
 
 
 def test_rank_summary_shared_and_numeric_sort():

@@ -207,6 +207,7 @@ def test_moe_top2_first_choices_outrank_second_choices():
     assert pack[1, 0].sum() == 0.0, "token 1's SECOND choice (E0) is dropped"
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 17): gates in analysis.yml
 def test_trainer_moe_top2_e2e():
     from tpu_dist.config import TrainConfig
     from tpu_dist.train.trainer import Trainer

@@ -3,6 +3,7 @@ GPipe/sequential, bubble accounting strictly smaller (VERDICT r1 #7)."""
 
 import jax
 import numpy as np
+import pytest
 from jax.sharding import NamedSharding
 
 from tpu_dist.comm import mesh as mesh_lib
@@ -48,6 +49,7 @@ def test_interleaved_sequential_forward_matches_plain_vit():
                                rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 17): gates in analysis.yml
 def test_interleaved_pp_training_matches_single_device():
     model = _model(interleave=2)
     opt = SGD()
@@ -109,6 +111,7 @@ def test_trainer_pp_interleaved_e2e():
     assert np.isfinite(out["loss"])
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 17): gates in analysis.yml
 def test_interleaved_m2s_matches_single_device():
     """M = 2S: the buffered lap-boundary handoff (depth M-S+1 ring buffer)
     must reproduce sequential numerics exactly (VERDICT r2 #7)."""

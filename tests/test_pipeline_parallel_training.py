@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 
 from tpu_dist.comm import mesh as mesh_lib
 from tpu_dist.config import TrainConfig
@@ -64,6 +65,7 @@ def test_dp_pp_training_matches_single_device():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5)
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 17): gates in analysis.yml
 def test_trainer_pp_e2e_with_eval_and_resume(tmp_path):
     cfg = TrainConfig(
         dataset="synthetic", model="vit_pp_tiny", num_classes=10, batch_size=16,

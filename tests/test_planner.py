@@ -414,6 +414,7 @@ def _run_plan_cli(*args, timeout=300):
     )
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 17): gates in analysis.yml
 def test_cli_plan_text_json_and_inject_miscost(tmp_path):
     """One invocation covers the whole happy-path contract: json format,
     plan_report written, TD118 verified, the inject-miscost probe caught

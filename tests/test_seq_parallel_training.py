@@ -3,6 +3,7 @@
 
 import jax
 import numpy as np
+import pytest
 
 from tpu_dist.comm import mesh as mesh_lib
 from tpu_dist.nn.vit import ViTDef
@@ -53,6 +54,7 @@ def test_dp_sp_training_matches_single_device():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-5)
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 17): gates in analysis.yml
 def test_trainer_sp_e2e():
     from tpu_dist.config import TrainConfig
     from tpu_dist.train.trainer import Trainer

@@ -107,7 +107,8 @@ def test_sharded_incomplete_is_invisible_and_refused(tmp_path):
 
 def test_trainer_fsdp_sharded_ckpt_resume(tmp_path):
     """e2e: FSDP trainer saves sharded, resumes from the manifest, params
-    match; async+sharded refused."""
+    match. (async+sharded — once refused, now the snapshot-then-write
+    path — is covered in tests/test_async_sharded_ckpt.py.)"""
     cfg = TrainConfig(
         dataset="synthetic", model="vit_tiny", num_classes=10, batch_size=64,
         epochs=1, steps_per_epoch=2, eval_every=0, synthetic_n=640,
@@ -127,9 +128,6 @@ def test_trainer_fsdp_sharded_ckpt_resume(tmp_path):
         jax.tree_util.tree_leaves(t2.state.params),
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
-
-    with pytest.raises(ValueError, match="mutually exclusive"):
-        Trainer(cfg.replace(async_ckpt=True))
 
 
 def test_best_save_uncommits_before_overwrite(tmp_path):

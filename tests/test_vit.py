@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from tpu_dist.comm.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
@@ -13,6 +14,7 @@ from tpu_dist.train.state import TrainState
 from tpu_dist.train.step import make_train_step
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 17): gates in analysis.yml
 def test_vit_b16_param_count():
     # ViT-B/16 published size ≈ 86.6M (ImageNet-1k head, no cls token here)
     p, _ = vit_b16().init(jax.random.PRNGKey(0))

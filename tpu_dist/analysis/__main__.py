@@ -14,6 +14,12 @@ sharding & collective audit (TD116/TD117) — and writes/prints the
 ``--auto_shard`` planner: enumerate + price + HBM-filter + rank the
 config families, TD118-verify the chosen plan against a fresh compile,
 and write the schema-pinned ``plan_report.json`` (docs/planner.md).
+
+``python -m tpu_dist.analysis tune-overlap`` runs Layer 4b — the
+comm/compute overlap autotuner: search the collective-scheduling knobs
+(pmean_fusion, quant_chunk, rs_ag_chunks), TD121-gate every candidate
+(payload bytes pinned, schedule must move), and write the schema-pinned
+``tune_report.json`` the planner/trainer consume (docs/analysis.md).
 """
 
 from __future__ import annotations
@@ -150,6 +156,12 @@ def plan_main(argv) -> int:
         "still compiles the chosen family fresh)",
     )
     ap.add_argument(
+        "--tune-report", default=None, metavar="TUNE_REPORT",
+        help="tune_report.json from `tune-overlap`: attach the tuner's "
+        "chosen schedule knobs to every candidate (tune_knobs) — knobs "
+        "never change the ranking (TD121: schedule-only transforms)",
+    )
+    ap.add_argument(
         "--hbm_budget_bytes", type=int, default=None,
         help="per-device HBM budget override (default: the chip table; "
         "unknown chips — CPU emulation — skip the feasibility filter)",
@@ -192,11 +204,21 @@ def plan_main(argv) -> int:
         except (OSError, ValueError) as e:
             print(f"tpu_dist.analysis plan: {e}", file=sys.stderr)
             return 2
+    tune_report = None
+    if args.tune_report:
+        from tpu_dist.analysis import overlap as overlap_lib
+
+        try:
+            tune_report = overlap_lib.load_tune_report(args.tune_report)
+        except (OSError, ValueError) as e:
+            print(f"tpu_dist.analysis plan: {e}", file=sys.stderr)
+            return 2
     plan = planner.build_plan(
         names=args.family,
         hbm_budget_bytes=args.hbm_budget_bytes,
         memory_headroom=args.memory_headroom,
         shard_report=shard_report,
+        tune_report=tune_report,
     )
     probe, violations = planner.verify_plan(plan)
     plan["verification"] = probe
@@ -237,6 +259,99 @@ def plan_main(argv) -> int:
     return 1 if violations else 0
 
 
+def tune_main(argv) -> int:
+    """The ``tune-overlap`` subcommand: Layer 4b — search the
+    collective-scheduling knobs, TD121-gate, emit tune_report.json."""
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_dist.analysis tune-overlap",
+        description="comm/compute overlap autotuner: search the "
+        "schedule-only collective knobs per config family (TD121-gated: "
+        "payload bytes pinned, schedule must move), write tune_report.json",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--out", default=None,
+        help="write the schema-pinned tune_report.json here",
+    )
+    ap.add_argument(
+        "--family", action="append",
+        help="tune only this config family (repeatable)",
+    )
+    ap.add_argument("--list-families", action="store_true")
+    ap.add_argument(
+        "--capture", default=None, metavar="DIR",
+        help="jax.profiler capture dir: use the measured overlap_frac "
+        "as the objective instead of the HLO schedule proxy",
+    )
+    ap.add_argument(
+        "--inject-payload", action="store_true",
+        help="ALSO re-gate a deliberately payload-perturbed copy of the "
+        "report — its TD121 findings are expected and prove the detector "
+        "is alive; exit 2 if it comes back clean",
+    )
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tpu_dist.analysis import overlap as overlap_lib
+
+    if args.list_families:
+        for name in overlap_lib.tunable_families():
+            print(name)
+        return 0
+    unknown = sorted(
+        set(args.family or ()) - set(overlap_lib.tunable_families())
+    )
+    if unknown:
+        print(
+            f"tpu_dist.analysis tune-overlap: unknown/untunable "
+            f"famil(ies) {unknown}; tunable: "
+            f"{overlap_lib.tunable_families()}",
+            file=sys.stderr,
+        )
+        return 2
+    report, violations = overlap_lib.tune(
+        names=args.family, capture_dir=args.capture
+    )
+    if args.inject_payload:
+        inj_vs = overlap_lib.recheck_report(
+            overlap_lib.inject_payload(report)
+        )
+        report["injected_payload_probe"] = {
+            "violations": [v.to_json() for v in inj_vs],
+            "caught": bool(inj_vs),
+        }
+        if not inj_vs:
+            print(
+                "tpu_dist.analysis tune-overlap: the injected payload-"
+                "perturbed report came back CLEAN — the TD121 detector "
+                "is dead",
+                file=sys.stderr,
+            )
+            return 2
+    if args.out:
+        overlap_lib.save_tune_report(report, args.out)
+    if args.format == "json":
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(overlap_lib.format_text(report))
+        for v in violations:
+            print(v.format_text())
+        if args.out:
+            print(f"tune-overlap: wrote {args.out}")
+    if report["counts"]["skipped"] and not args.family:
+        # same degrade-per-family/fail-the-gate contract as shard/plan
+        print(
+            f"tpu_dist.analysis tune-overlap: "
+            f"{report['counts']['skipped']} famil(ies) skipped: "
+            f"{report['skips']}",
+            file=sys.stderr,
+        )
+        return 2
+    return 1 if violations else 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -244,6 +359,8 @@ def main(argv=None) -> int:
         return shard_main(argv[1:])
     if argv and argv[0] == "plan":
         return plan_main(argv[1:])
+    if argv and argv[0] == "tune-overlap":
+        return tune_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m tpu_dist.analysis",
         description="distributed-training lint (TD0xx) + jaxpr audit (TD1xx)",

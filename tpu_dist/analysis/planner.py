@@ -240,6 +240,7 @@ def build_plan(
     gauges: Optional[dict] = None,
     shard_report: Optional[dict] = None,
     applyable_only: bool = False,
+    tune_report: Optional[dict] = None,
 ) -> dict:
     """Search the family space and return the schema-pinned plan report.
 
@@ -249,6 +250,13 @@ def build_plan(
     (determinism in tests; replaying a recorded capture).
     ``applyable_only`` restricts the space to
     :data:`FAMILY_TRAIN_OVERRIDES` (the ``--auto_shard apply`` search).
+    ``tune_report``: a loaded ``tune_report.json`` dict
+    (``analysis/overlap.py``) — every candidate row (and the chosen
+    plan) gets the tuner's chosen schedule knobs attached as
+    ``tune_knobs``, so an applied plan carries its overlap tuning along.
+    Knobs never change the ranking: they are schedule-only transforms
+    (TD121), and the priced wire bytes are knob-invariant by
+    construction.
 
     Infeasible candidates are refused through
     :func:`tpu_dist.obs.memory.preflight_check(action="refuse")` — the
@@ -327,6 +335,13 @@ def build_plan(
     rows.sort(key=lambda r: (r["predicted_step_s"], r["family"]))
     for i, row in enumerate(rows):
         row["rank"] = i + 1
+    if tune_report is not None:
+        from tpu_dist.analysis import overlap as overlap_lib
+
+        for row in rows:
+            row["tune_knobs"] = overlap_lib.chosen_knobs(
+                tune_report, row["family"]
+            )
 
     dev = jax.devices()[0]
     plan = {
@@ -343,6 +358,9 @@ def build_plan(
         },
         "candidates": rows,
         "chosen": copy.deepcopy(rows[0]) if rows else None,
+        "tune_objective": (
+            tune_report.get("objective") if tune_report is not None else None
+        ),
         "refused": refused,
         "skips": skips,
         "counts": {

@@ -266,6 +266,29 @@ RULES: dict[str, Rule] = {
             "docs/planner.md)",
         ),
         Rule(
+            "TD120",
+            "async-ckpt-semantics-preserved",
+            "the async sharded checkpoint path (--sharded_ckpt + "
+            "--async_ckpt) must leave the traced train step byte-identical "
+            "to synchronous saves AND restore bit-exact to the synchronous "
+            "sharded format; the injected EIO and SIGTERM fault probes "
+            "must surface through the drain path — an uncaught probe "
+            "means the detector is dead (CLI exit 2) "
+            "(tpu_dist/ckpt/checkpoint.py, docs/checkpointing.md)",
+        ),
+        Rule(
+            "TD121",
+            "tuner-knob-schedule-only",
+            "an overlap-autotuner knob (pmean_fusion, rs_ag_chunks, "
+            "quant_chunk) changed the HLO payload-byte inventory shardlint "
+            "pins, or failed to move the collective schedule at all — "
+            "knobs must be semantics-preserving schedule transforms by "
+            "construction, and a payload drift or a vacuous knob is a "
+            "lying search space; the --inject-payload probe must be "
+            "caught or the detector is dead (CLI exit 2) "
+            "(tpu_dist/analysis/overlap.py, docs/analysis.md)",
+        ),
+        Rule(
             "TD104",
             "quantized-wire-bytes-over-budget",
             "gradient-collective payload bytes of a quantized wire format "

@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import time
 import zipfile
 import zlib
 from typing import Any, List, Optional, Tuple
@@ -283,15 +284,9 @@ def save_best(
     return _write_npz(ckpt_dir, "ckpt_best.npz", flat, meta)
 
 
-class AsyncCheckpointer:
-    """Overlap checkpoint WRITES with training (the orbax-style async-save
-    pattern, self-contained).
-
-    The device→host snapshot (``_flatten``) stays synchronous — it is the
-    data dependency on the live ``TrainState`` and, multi-host, a
-    collective every process must join. The expensive part (npz
-    serialization + atomic rename + pruning) runs on a single worker
-    thread over the host copies, so the train loop resumes immediately.
+class _AsyncWriter:
+    """Single-worker background publisher shared by the async writers
+    (:class:`AsyncCheckpointer`, :class:`AsyncShardedCheckpointer`).
 
     Publish order is the submission order (one worker thread). A save
     never blocks on an earlier write still in flight — it only harvests
@@ -300,6 +295,15 @@ class AsyncCheckpointer:
     ``wait()`` (or ``close()``, which also releases the worker thread)
     before process exit — the Trainer does, at the end of ``fit()`` and in
     the interrupt path.
+
+    ``wait``/``close`` take an optional ``timeout`` (seconds) and return
+    False when it expires with writes still in flight — the bounded-drain
+    contract the Trainer's ``_ckpt_close`` builds its loud
+    refusal-to-lose-data path on. A timed-out ``close`` cancels writes
+    that have not STARTED (their data is lost and the caller must say so);
+    the write already on the worker thread keeps running to completion so
+    a half-written file is never abandoned mid-publish (atomic tmp+rename
+    makes even that crash-safe).
     """
 
     def __init__(self) -> None:
@@ -308,30 +312,75 @@ class AsyncCheckpointer:
         self._pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="ckpt")
         self._pending: list = []
 
-    def _harvest(self, block: bool) -> None:
+    @property
+    def in_flight(self) -> int:
+        """Writes submitted but not yet finished (snapshot data whose loss
+        a timed-out drain must report)."""
+        return sum(1 for f in self._pending if not f.done())
+
+    def _harvest(self, block: bool, deadline: Optional[float] = None) -> bool:
+        import concurrent.futures as _cf  # noqa: PLC0415
+
         first_err = None
+        drained = True
         while self._pending and (block or self._pending[0].done()):
-            fut = self._pending.pop(0)
+            fut = self._pending[0]
             try:
-                fut.result()
+                if deadline is None:
+                    fut.result()
+                else:
+                    fut.result(max(0.0, deadline - time.monotonic()))
+            except _cf.TimeoutError:
+                if not fut.done():  # drain timeout, not the write's own error
+                    drained = False
+                    break
+                if first_err is None:  # the WRITE raised a TimeoutError
+                    first_err = fut.exception()
             except Exception as e:  # keep draining; re-raise the first
                 if first_err is None:
                     first_err = e
+            self._pending.pop(0)
         if first_err is not None:
             raise first_err
+        return drained
 
-    def wait(self) -> None:
-        """Block until every outstanding write is published; re-raises the
-        first writer-thread exception here."""
-        self._harvest(block=True)
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until every outstanding write is published (re-raising the
+        first writer-thread exception here), or until ``timeout`` seconds
+        elapse — returns False iff the timeout expired with writes still
+        in flight."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        return self._harvest(block=True, deadline=deadline)
 
-    def close(self) -> None:
-        """``wait()`` then release the worker thread. The instance is dead
-        afterwards (a new save would raise from the shut-down pool)."""
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """``wait(timeout)`` then release the worker thread; the instance
+        is dead afterwards (a new save would raise from the shut-down
+        pool). Returns False iff the bounded drain gave up — not-yet-
+        started writes are cancelled and the caller owns reporting the
+        loss (``in_flight`` still counts them)."""
         try:
-            self.wait()
-        finally:
+            drained = self.wait(timeout)
+        except Exception:
             self._pool.shutdown(wait=True)
+            raise
+        if drained:
+            self._pool.shutdown(wait=True)
+        else:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+        return drained
+
+
+class AsyncCheckpointer(_AsyncWriter):
+    """Overlap checkpoint WRITES with training (the orbax-style async-save
+    pattern, self-contained).
+
+    The device→host snapshot (``_flatten``) stays synchronous — it is the
+    data dependency on the live ``TrainState`` and, multi-host, a
+    collective every process must join. The expensive part (npz
+    serialization + atomic rename + pruning) runs on a single worker
+    thread over the host copies, so the train loop resumes immediately.
+    Drain semantics live in :class:`_AsyncWriter`.
+    """
 
     def save(
         self,
@@ -570,39 +619,41 @@ def _parse_shard_key(skey: str):
     return key, origin, extent
 
 
-def save_sharded(
-    ckpt_dir: str,
+class ShardSnapshot:
+    """Phase-1 product of the two-phase sharded save: this process's shard
+    slices as host numpy copies, plus everything phase 2 (serialize + CRC +
+    publish + manifest commit) needs — so phase 2 can run on a background
+    thread with no reference to the live ``TrainState`` (docs/
+    checkpointing.md "Two-phase sharded saves")."""
+
+    __slots__ = ("stem", "epoch", "pid", "nproc", "shard_flat", "shapes", "meta")
+
+    def __init__(self, stem, epoch, pid, nproc, shard_flat, shapes, meta):
+        self.stem = stem
+        self.epoch = epoch
+        self.pid = pid
+        self.nproc = nproc
+        self.shard_flat = shard_flat
+        self.shapes = shapes
+        self.meta = meta
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(v.nbytes) for v in self.shard_flat.values())
+
+
+def snapshot_sharded(
     state: TrainState,
     epoch: int,
-    keep_last: Optional[int] = None,
     extra_meta: Optional[dict] = None,
     stem: Optional[str] = None,
-) -> Optional[str]:
-    """Every process writes its own shard file; process 0 commits the
-    manifest last. Returns the manifest path on process 0, else None.
-
-    ``stem`` overrides the file-name stem (default ``ckpt_{epoch}``; the
-    best-model save uses ``ckpt_best``). ``keep_last`` prunes old EPOCH
-    checkpoints (manifest removed first — uncommit — then shard files;
-    orphaned shard files of uncommitted epochs are swept too)."""
-    os.makedirs(ckpt_dir, exist_ok=True)
+) -> ShardSnapshot:
+    """Phase 1 of the sharded save: device→host copies of the shard slices
+    this process owns. Collective-free (unlike ``_flatten``: every slice
+    read here is locally addressable) and filesystem-free — this is the
+    ONLY part of a sharded save that must block the step loop."""
     stem = stem or f"ckpt_{epoch}"
     pid, nproc = jax.process_index(), jax.process_count()
-    mpath = os.path.join(ckpt_dir, f"{stem}.manifest.json")
-
-    # UNCOMMIT an existing checkpoint at this stem before any process
-    # replaces its shard file — a crash mid-overwrite must leave an
-    # (invisible) uncommitted checkpoint, never a committed mixed one
-    if pid == 0:
-        try:
-            os.remove(mpath)
-        except FileNotFoundError:
-            pass
-    if nproc > 1:
-        from jax.experimental import multihost_utils  # noqa: PLC0415
-
-        multihost_utils.sync_global_devices(f"ckpt_uncommit_{stem}")
-
     shard_flat: dict = {}
     shapes: dict = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(state._asdict())[0]:
@@ -624,13 +675,40 @@ def save_sharded(
             if pid == 0:
                 data = np.asarray(leaf)
                 shard_flat[_shard_key(key, (), data.shape)] = data
+    meta = {"epoch": epoch, "step": int(_scalar_to_host(state.step))}
+    if extra_meta:
+        meta.update(extra_meta)
+    return ShardSnapshot(stem, epoch, pid, nproc, shard_flat, shapes, meta)
+
+
+def _sharded_uncommit(ckpt_dir: str, stem: str) -> None:
+    """UNCOMMIT an existing checkpoint at this stem before any process
+    replaces its shard file — a crash mid-overwrite must leave an
+    (invisible) uncommitted checkpoint, never a committed mixed one.
+    Collective (the barrier), so it always runs on the main thread."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    if jax.process_index() == 0:
+        try:
+            os.remove(os.path.join(ckpt_dir, f"{stem}.manifest.json"))
+        except FileNotFoundError:
+            pass
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils  # noqa: PLC0415
+
+        multihost_utils.sync_global_devices(f"ckpt_uncommit_{stem}")
+
+
+def _write_shard_file(ckpt_dir: str, snap: ShardSnapshot) -> str:
+    """Phase 2a: serialize + CRC32-stamp + retry + atomically publish this
+    process's shard file. Host-side only — safe on a worker thread."""
     # self-describing integrity: each shard carries the CRC32 of its own
     # entries (rank 0 cannot know other processes' bytes for the manifest)
+    shard_flat = dict(snap.shard_flat)
     shard_flat["__crc__"] = np.frombuffer(
         json.dumps({k: _entry_crc(v) for k, v in shard_flat.items()}).encode(),
         dtype=np.uint8,
     )
-    name = f"{stem}.shard{pid}of{nproc}.npz"
+    name = f"{snap.stem}.shard{snap.pid}of{snap.nproc}.npz"
     tmp = os.path.join(ckpt_dir, name + ".tmp")
 
     def write_shard() -> None:
@@ -652,31 +730,58 @@ def save_sharded(
         )
     except OSError:  # tpu-dist: ignore[TD006] — telemetry only (see _write_npz)
         pass
+    return os.path.join(ckpt_dir, name)
 
-    # the manifest is the commit marker: all shard files must exist first
-    if nproc > 1:
-        from jax.experimental import multihost_utils  # noqa: PLC0415
 
-        multihost_utils.sync_global_devices(f"ckpt_commit_{stem}")
-    if pid != 0:
-        return None
-    meta = {"epoch": epoch, "step": int(_scalar_to_host(state.step))}
-    if extra_meta:
-        meta.update(extra_meta)
-    manifest = {"meta": meta, "n_shards": nproc, "shapes": shapes}
+def _await_shard_files(
+    ckpt_dir: str, snap: ShardSnapshot, timeout_s: float
+) -> None:
+    """Filesystem commit barrier for the BACKGROUND publish path: rank 0's
+    writer thread must not commit the manifest until every process's shard
+    file is published. Shard files appear atomically (tmp+rename), so
+    existence ⇒ complete. The synchronous path uses ``sync_global_devices``
+    instead — a jax collective a background thread must never hold."""
+    names = [
+        f"{snap.stem}.shard{p}of{snap.nproc}.npz" for p in range(snap.nproc)
+    ]
+    deadline = time.monotonic() + timeout_s
+    while True:
+        missing = [
+            n for n in names if not os.path.exists(os.path.join(ckpt_dir, n))
+        ]
+        if not missing:
+            return
+        if time.monotonic() >= deadline:
+            raise RuntimeError(
+                f"sharded-ckpt commit barrier: {len(missing)} of "
+                f"{snap.nproc} shard files still missing after "
+                f"{timeout_s:.0f}s ({missing[:3]}) — refusing to commit "
+                f"manifest {snap.stem} over an incomplete shard set"
+            )
+        time.sleep(0.05)
+
+
+def _commit_manifest(
+    ckpt_dir: str, snap: ShardSnapshot, keep_last: Optional[int] = None
+) -> str:
+    """Phase 2b (rank 0 only): write the manifest — the commit marker —
+    then prune. Host-side only; the caller guarantees all shard files are
+    already published (barrier)."""
+    mpath = os.path.join(ckpt_dir, f"{snap.stem}.manifest.json")
+    manifest = {"meta": snap.meta, "n_shards": snap.nproc, "shapes": snap.shapes}
     tmp = mpath + ".tmp"
 
     def write_manifest() -> None:
         faults.on_ckpt_write()
-        # tpu-dist: ignore[TD002] — save_sharded returned above unless
-        # pid == 0; the manifest commit is rank-0-only by construction
+        # tpu-dist: ignore[TD002] — callers gate on snap.pid == 0; the
+        # manifest commit is rank-0-only by construction
         with open(tmp, "w") as f:
             json.dump(manifest, f)
         os.replace(tmp, mpath)
 
     with spans.span("ckpt/write_manifest", file=os.path.basename(mpath)):
         retry_lib.retry_call(
-            write_manifest, retries=_IO_RETRIES, describe=f"commit of {stem}"
+            write_manifest, retries=_IO_RETRIES, describe=f"commit of {snap.stem}"
         )
     counters.inc("ckpt.writes")
     faults.on_ckpt_published(mpath)
@@ -687,7 +792,7 @@ def save_sharded(
             for m in (_MANIFEST_RE.search(n_) for n_ in os.listdir(ckpt_dir))
             if m
         )
-        kept = set(committed[-keep_last:]) | {epoch}
+        kept = set(committed[-keep_last:]) | {snap.epoch}
         # one sweep removes old manifests (uncommit first: the sort below
         # puts each epoch's manifest before its shard files), old shards,
         # AND orphaned shards whose epoch was never committed
@@ -707,6 +812,58 @@ def save_sharded(
     return mpath
 
 
+def publish_sharded_snapshot(
+    ckpt_dir: str,
+    snap: ShardSnapshot,
+    keep_last: Optional[int] = None,
+    commit_timeout_s: float = 600.0,
+) -> Optional[str]:
+    """Phase 2 for the BACKGROUND path: publish this process's shard file,
+    then (rank 0) wait for the full shard set via the filesystem barrier
+    and commit the manifest. Host-side only — this is what
+    :class:`AsyncShardedCheckpointer` runs on its worker thread."""
+    _write_shard_file(ckpt_dir, snap)
+    if snap.pid != 0:
+        return None
+    if snap.nproc > 1:
+        _await_shard_files(ckpt_dir, snap, commit_timeout_s)
+    return _commit_manifest(ckpt_dir, snap, keep_last)
+
+
+def save_sharded(
+    ckpt_dir: str,
+    state: TrainState,
+    epoch: int,
+    keep_last: Optional[int] = None,
+    extra_meta: Optional[dict] = None,
+    stem: Optional[str] = None,
+) -> Optional[str]:
+    """Every process writes its own shard file; process 0 commits the
+    manifest last. Returns the manifest path on process 0, else None.
+
+    ``stem`` overrides the file-name stem (default ``ckpt_{epoch}``; the
+    best-model save uses ``ckpt_best``). ``keep_last`` prunes old EPOCH
+    checkpoints (manifest removed first — uncommit — then shard files;
+    orphaned shard files of uncommitted epochs are swept too).
+
+    This is the SYNCHRONOUS composition of the two-phase protocol —
+    uncommit, snapshot, write, device barrier, commit. The async
+    composition (:class:`AsyncShardedCheckpointer`) runs everything after
+    the snapshot on a worker thread."""
+    stem = stem or f"ckpt_{epoch}"
+    _sharded_uncommit(ckpt_dir, stem)
+    snap = snapshot_sharded(state, epoch, extra_meta=extra_meta, stem=stem)
+    _write_shard_file(ckpt_dir, snap)
+    # the manifest is the commit marker: all shard files must exist first
+    if snap.nproc > 1:
+        from jax.experimental import multihost_utils  # noqa: PLC0415
+
+        multihost_utils.sync_global_devices(f"ckpt_commit_{stem}")
+    if snap.pid != 0:
+        return None
+    return _commit_manifest(ckpt_dir, snap, keep_last)
+
+
 class ShardedCheckpointer:
     """Drop-in for the module-level save/save_best API, writing the sharded
     format (the Trainer's ``--sharded_ckpt`` adapter)."""
@@ -722,6 +879,68 @@ class ShardedCheckpointer:
         em = dict(extra_meta or {})
         em["metric"] = metric
         return save_sharded(ckpt_dir, state, epoch, extra_meta=em, stem="ckpt_best")
+
+
+class AsyncShardedCheckpointer(_AsyncWriter):
+    """Snapshot-then-write sharded checkpointing (``--sharded_ckpt`` +
+    ``--async_ckpt``): the step loop blocks only for the uncommit barrier
+    and the device→host :func:`snapshot_sharded`; serialize + CRC32 +
+    retry + atomic publish + the manifest commit all run on the worker
+    thread (:func:`publish_sharded_snapshot`).
+
+    The cross-process commit barrier moves off the critical path by
+    changing mechanism, not semantics: the synchronous path holds a
+    ``sync_global_devices`` barrier between shard writes and the manifest;
+    the background path has rank 0's writer thread poll the filesystem for
+    the full shard set (shard files publish atomically, so existence ⇒
+    complete) before committing — a jax collective must never run off the
+    main thread. The uncommit barrier STAYS synchronous at submit time:
+    it is cheap (one unlink + barrier) and guarantees no stale manifest
+    can point at a mixed shard set while the background write replaces
+    files. Same EVENTUAL-path contract as :class:`AsyncCheckpointer`:
+    the returned manifest path is valid only after ``wait``/``close``;
+    write errors (including the injected-EIO fault ladder) surface on the
+    next save/wait/close."""
+
+    def __init__(self, commit_timeout_s: float = 600.0) -> None:
+        super().__init__()
+        self._commit_timeout_s = commit_timeout_s
+
+    def _submit(
+        self, ckpt_dir, state, epoch, keep_last, extra_meta, stem
+    ) -> Optional[str]:
+        if any(getattr(f, "_stem", None) == stem for f in self._pending):
+            # an in-flight publish of THIS stem (ckpt_best overwrite, a
+            # replayed epoch): drain first so the main-thread uncommit
+            # cannot race its background manifest commit
+            self.wait()
+        _sharded_uncommit(ckpt_dir, stem)
+        # the ONLY blocking window: the device→host snapshot
+        snap = snapshot_sharded(state, epoch, extra_meta=extra_meta, stem=stem)
+        self._harvest(block=False)  # surface finished writes' errors only
+        fut = self._pool.submit(
+            publish_sharded_snapshot, ckpt_dir, snap,
+            keep_last, self._commit_timeout_s,
+        )
+        fut._stem = stem  # for the same-stem drain guard above
+        self._pending.append(fut)
+        if snap.pid != 0:
+            return None
+        return os.path.join(ckpt_dir, f"{stem}.manifest.json")
+
+    def save(
+        self, ckpt_dir, state, epoch, keep_last=None, extra_meta=None
+    ) -> Optional[str]:
+        return self._submit(
+            ckpt_dir, state, epoch, keep_last, extra_meta, f"ckpt_{epoch}"
+        )
+
+    def save_best(
+        self, ckpt_dir, state, epoch, metric, extra_meta=None
+    ) -> Optional[str]:
+        em = dict(extra_meta or {})
+        em["metric"] = metric
+        return self._submit(ckpt_dir, state, epoch, None, em, "ckpt_best")
 
 
 def all_sharded_checkpoints(ckpt_dir: str) -> List[Tuple[str, int]]:

@@ -90,8 +90,15 @@ class TrainConfig:
                                    # inside an epoch (kill-9 safety for long
                                    # epochs; resume re-enters at the batch)
     resume: bool = False
-    async_ckpt: bool = False       # overlap ckpt npz writes with training
-                                   # (ckpt/checkpoint.py::AsyncCheckpointer)
+    async_ckpt: bool = False       # overlap ckpt writes with training
+                                   # (ckpt/checkpoint.py::AsyncCheckpointer;
+                                   # with --sharded_ckpt: the snapshot-then-
+                                   # write AsyncShardedCheckpointer)
+    ckpt_drain_timeout_s: float = 120.0  # bounded drain of in-flight async
+                                   # ckpt writes at fit end / interrupt;
+                                   # expiry abandons them LOUDLY (counted
+                                   # as ckpt.drain_abandoned); <=0 = wait
+                                   # forever
     eval_every: int = 1
     log_every: int = 20
     log_file: Optional[str] = None # JSONL metrics history (rank 0)
@@ -216,6 +223,21 @@ class TrainConfig:
                                    # two-stage quantized RS+AG); int8_ef adds
                                    # error-feedback residuals in TrainState
                                    # (docs/compression.md)
+    quant_chunk: int = 0           # elements per int8 quantization scale
+                                   # (0 = comm/quantize.DEFAULT_CHUNK); a
+                                   # tune-overlap schedule knob — payload
+                                   # bytes are chunk-invariant (TD121)
+    pmean_fusion: str = "fused"    # fused | per_leaf: one multi-operand grad
+                                   # pmean vs one per leaf — schedule-only
+                                   # overlap knob (analysis/overlap.py)
+    rs_ag_chunks: int = 1          # split the ZeRO-1 reduce-scatter/all-
+                                   # gather pair into k pipelined column-
+                                   # group collectives (payload-identical;
+                                   # tune-overlap's zero1 knob)
+    tune_report: str = ""          # path to a tune_report.json (make
+                                   # tune-overlap): apply the tuner's chosen
+                                   # schedule knobs for this config's family
+                                   # (explicit knob flags win over the report)
     sharded_ckpt: bool = False     # per-process shard files + rank-0 manifest;
                                    # no gather at save time (FSDP/ZeRO scale)
     auto_shard: str = "off"        # off | plan | apply — run the static
@@ -345,6 +367,29 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "the plain DP, fused-epoch, and ZeRO-1 paths; not "
                         "under --fsdp (GSPMD-inserted collectives) or "
                         "sp/tp/ep/pp (docs/compression.md)")
+    p.add_argument("--quant_chunk", type=int, default=d.quant_chunk,
+                   metavar="N",
+                   help="elements per int8 quantization scale (0 = the "
+                        "comm/quantize default) — a tune-overlap schedule "
+                        "knob: payload bytes are chunk-invariant, only the "
+                        "f32 scale sideband granularity moves (TD121)")
+    p.add_argument("--pmean_fusion", choices=("fused", "per_leaf"),
+                   default=d.pmean_fusion,
+                   help="data-parallel grad reduce granularity: one fused "
+                        "multi-operand pmean, or one pmean per gradient "
+                        "leaf (schedule-only overlap knob; identical "
+                        "payload bytes — analysis/overlap.py)")
+    p.add_argument("--rs_ag_chunks", type=int, default=d.rs_ag_chunks,
+                   metavar="K",
+                   help="split the ZeRO-1 reduce-scatter/all-gather pair "
+                        "into K pipelined column-group collectives "
+                        "(payload-identical schedule knob; needs "
+                        "--shard_weight_update)")
+    p.add_argument("--tune_report", type=str, default=d.tune_report,
+                   metavar="PATH",
+                   help="tune_report.json from `make tune-overlap`: apply "
+                        "the tuner's chosen schedule knobs for this "
+                        "config's family (explicitly-set knob flags win)")
     p.add_argument("--no_sync_bn", dest="sync_bn", action="store_false",
                    help="per-replica BatchNorm statistics (SyncBN off)")
     p.add_argument("--no_nan_guard", dest="nan_guard", action="store_false")
@@ -396,14 +441,22 @@ def add_reference_flags(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true")
     p.add_argument("--async_ckpt", action="store_true",
                    help="write checkpoints on a background thread (training "
-                        "continues during the npz serialization)")
+                        "continues during the serialization); composes with "
+                        "--sharded_ckpt as snapshot-then-write: the step loop "
+                        "blocks only for the device→host snapshot, the "
+                        "background writer owns serialize+CRC+commit")
     p.add_argument("--sharded_ckpt", action="store_true",
                    help="sharded checkpoint format: every process writes only "
                         "its own shard slices + a rank-0 manifest (commit "
                         "marker) — no allgather at save time, the FSDP/ZeRO-"
-                        "scale choice; mutually exclusive with --async_ckpt "
-                        "(each process's write is already 1/n-sized, so the "
-                        "background-thread overlap buys little)")
+                        "scale choice; add --async_ckpt to move everything "
+                        "but the snapshot off the step loop")
+    p.add_argument("--ckpt_drain_timeout_s", type=float,
+                   default=d.ckpt_drain_timeout_s, metavar="S",
+                   help="bounded drain of in-flight async checkpoint writes "
+                        "at fit end/interrupt; on expiry they are abandoned "
+                        "LOUDLY (counted as ckpt.drain_abandoned) — <=0 "
+                        "waits forever")
     p.add_argument("--ckpt_verify", dest="ckpt_verify", action="store_true",
                    default=d.ckpt_verify,
                    help="verify per-entry CRC32 stamps at restore and fall "

@@ -61,10 +61,10 @@ from tpu_dist.obs import counters as counters_lib
 from tpu_dist.obs import export as export_lib
 
 #: ``fleet`` records stamp the CURRENT history schema (metrics/
-#: history.py — v12 after the additive ``plan`` kind). Kept as a
+#: history.py — v13 after the additive ``tune`` kind). Kept as a
 #: literal so this module stays jax-free; ``tests/test_fleet.py`` pins
 #: it to the real SCHEMA_VERSION so the two can never drift silently.
-FLEET_SCHEMA_VERSION = 12
+FLEET_SCHEMA_VERSION = 13
 
 #: Heartbeat older than this reads as a dead/wedged run (matches the
 #: ``obs tail`` STALE threshold and the builtin heartbeat_stale rule).

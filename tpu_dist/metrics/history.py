@@ -50,7 +50,12 @@ snapshot live at the crash — ``obs/memory.py``, docs/observability.md
 mode, predicted step time, gauge source; after a profiled run a second
 ``plan`` record lands with the achieved step time and the TD119
 ``planner_error_frac`` drift scalar — ``tpu_dist/analysis/planner.py``,
-docs/planner.md)
+docs/planner.md); v13 added the tuner layer — the ``tune`` kind (the
+``--tune_report`` knob application at fit() start: the config's planner
+family, the schedule knobs actually applied, explicit user overrides
+kept, and the tuner objective; the same knobs ride the counter snapshot
+as ``tune.*`` gauges — ``tpu_dist/analysis/overlap.py``,
+docs/analysis.md)
 (docs/observability.md). Consumers (``obs summarize``/``compare``) read
 all versions: every addition is a new kind or optional field, never a
 changed one, and readers skip-with-count kinds they don't know — so a
@@ -73,13 +78,16 @@ import jax
 
 from tpu_dist.obs import counters as counters_lib
 
-SCHEMA_VERSION = 12  # v12 (additive): 'plan' records — the --auto_shard
-#                      chosen plan + TD119 predicted-vs-achieved
-#                      planner_error_frac (tpu_dist/analysis/planner.py);
-#                      v11 added 'memory' HBM-ledger records
-#                      (tpu_dist/obs/memory.py); v10 'serve' serving-SLO
-#                      windows; v9 'postmortem' crash bundles; v8 'fleet'
-#                      scheduler decisions; v7 'resume' segment boundaries
+SCHEMA_VERSION = 13  # v13 (additive): 'tune' records — the --tune_report
+#                      overlap-autotuner knob application + tune.* gauges
+#                      (tpu_dist/analysis/overlap.py); v12 added 'plan'
+#                      records — the --auto_shard chosen plan + TD119
+#                      predicted-vs-achieved planner_error_frac
+#                      (tpu_dist/analysis/planner.py); v11 'memory'
+#                      HBM-ledger records (tpu_dist/obs/memory.py);
+#                      v10 'serve' serving-SLO windows; v9 'postmortem'
+#                      crash bundles; v8 'fleet' scheduler decisions;
+#                      v7 'resume' segment boundaries
 
 
 class MetricsHistory:

@@ -75,6 +75,14 @@ METRIC_DIRECTIONS: dict = {
     # slack of 0.02: achieved step time wobbles a couple of points on
     # quiet reruns, and a pure ratio of a small fraction would flag them.
     "planner_error_frac": ("lower", 0.02),
+    # the async-checkpoint layer's gating scalar (goodput ledger bucket,
+    # obs/goodput.py; ckpt/checkpoint.py two-phase sharded saves): total
+    # wall-clock seconds the step loop spent blocked on checkpoint
+    # save/restore. HIGHER is a regression — a save that used to hide
+    # behind compute (snapshot-then-write, --async_ckpt) has started
+    # blocking again. Absolute slack of 0.25 s: restore ladders and
+    # first-save directory creation wobble tenths of a second run to run.
+    "ckpt_s": ("lower", 0.25),
     # bench-mode per-record fields
     "value": ("higher", 0.0),          # images/sec (or tokens/sec)
     "sec_per_epoch": ("lower", 0.0),
@@ -83,6 +91,12 @@ METRIC_DIRECTIONS: dict = {
     "step_ms_p95": ("lower", 0.0),
     "step_ms_p99": ("lower", 0.0),
     "mfu": ("higher", 0.005),
+    # bench --ckpt records (bench.py checkpoint drill): milliseconds the
+    # step loop was blocked per save — the snapshot window for async
+    # saves, the whole serialize+CRC+write for sync ones. LOWER is
+    # better; absolute slack of 5 ms because host-side device_get of a
+    # small model wobbles a few ms on shared CI machines.
+    "ckpt_blocked_ms": ("lower", 5.0),
     # serving (``--slo`` gate + bench --serve records, serve/slo.py):
     # latency/queue metrics are lower-is-better; a LOWER-latency
     # candidate is an improvement and must never be flagged.
@@ -136,7 +150,7 @@ REPORT_METRICS: Tuple[Tuple[str, str, float], ...] = _table((
     "images_per_sec_mean", "step_time_p50_s", "step_time_p95_s",
     "step_time_p99_s", "data_stall_frac", "mfu_mean", "final_loss",
     "final_val_top1", "goodput_frac", "overlap_frac", "collective_frac",
-    "peak_hbm_bytes", "planner_error_frac",
+    "peak_hbm_bytes", "planner_error_frac", "ckpt_s",
 ))
 
 #: the ``--goodput`` gate's metric set: time-to-useful-work only. The
@@ -171,6 +185,9 @@ BENCH_FIELDS: Tuple[Tuple[str, str, float], ...] = _table((
     # analysis/planner.py) — bench measures real step time next to the
     # plan's prediction, so cost-model drift gates per bench record too
     "planner_error_frac",
+    # ...and the checkpoint drill's blocking window (bench.py --ckpt) —
+    # a save that stopped hiding behind the step loop gates here
+    "ckpt_blocked_ms",
     # serving bench records (bench.py --serve)
     "requests_per_s", "latency_p50_ms", "latency_p99_ms",
     "batch_occupancy",
@@ -230,6 +247,10 @@ def report_scalars(report: dict) -> dict:
         "planner_error_frac": (report.get("plan") or {}).get(
             "planner_error_frac"
         ),
+        # the async-checkpoint layer's blocking total (goodput ledger
+        # 'ckpt' bucket); None — skipped, never faked — on a ledger-less
+        # log. Gates the two-phase save's whole point: hiding the write.
+        "ckpt_s": gp.get("ckpt_s"),
     }
 
 

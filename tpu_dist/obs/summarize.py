@@ -30,7 +30,7 @@ from tpu_dist.obs import memory as memory_lib
 #: kinds summarized; their unknown kinds are skipped with a count — the
 #: forward-compat contract that lets v3 tooling read v4 logs and vice
 #: versa (every schema bump is additive).
-SUPPORTED_SCHEMA = 12
+SUPPORTED_SCHEMA = 13
 
 #: Record kinds this reader folds into the report. Anything else is
 #: counted into ``skipped_kinds`` — never an error, never silent.
@@ -38,7 +38,7 @@ KNOWN_KINDS = frozenset((
     "train_epoch", "eval", "straggler", "anomaly", "device_stats",
     "auto_recover", "spans", "goodput", "profile", "alert",
     "profile_analysis", "resume", "fleet", "postmortem", "serve",
-    "memory", "plan",
+    "memory", "plan", "tune",
 ))
 
 
@@ -84,6 +84,7 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
     memory_records: List[dict] = []  # HBM-ledger snapshots (schema v11)
     oom_events: List[dict] = []      # parsed RESOURCE_EXHAUSTED crashes
     plan_records: List[dict] = []    # --auto_shard plan / TD119 drift (v12)
+    tune_records: List[dict] = []    # --tune_report knob application (v13)
     dstats: dict = {}  # epoch -> per-epoch device_stats aggregate
     recoveries = 0
     prev_counters: Optional[dict] = None
@@ -243,6 +244,16 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
                           "n_candidates", "n_refused")
                 if rec.get(k) is not None
             })
+        elif kind == "tune":
+            # a --tune_report application (schema v13, analysis/overlap.py):
+            # which schedule knobs the run trains with, which the user
+            # kept, and the tuner objective they were chosen under
+            tune_records.append({
+                k: rec.get(k)
+                for k in ("epoch", "family", "report", "objective",
+                          "applied", "user_overrides")
+                if rec.get(k) is not None
+            })
         elif kind == "profile":
             profiles.append({
                 k: rec.get(k)
@@ -369,6 +380,18 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
                 if plan_records[-1].get(k) is not None
             }
             if plan_records else None
+        ),
+        "tune_records": tune_records,
+        "tune": (
+            # the gating view of the tuner layer: the last application
+            # wins (a resume re-applies and re-announces)
+            {
+                k: tune_records[-1].get(k)
+                for k in ("family", "objective", "applied",
+                          "user_overrides")
+                if tune_records[-1].get(k) is not None
+            }
+            if tune_records else None
         ),
         "stragglers": stragglers,
         "anomalies": anomalies,

@@ -164,6 +164,13 @@ class Trainer:
         if cfg.auto_shard != "off":
             cfg = self._run_auto_shard(cfg, mesh)
             self.cfg = cfg
+        # --tune_report: apply the overlap autotuner's chosen schedule
+        # knobs for this config's family (AFTER --auto_shard apply, so the
+        # knobs land on the family actually being trained)
+        self._tune = None
+        if cfg.tune_report:
+            cfg = self._apply_tune_report(cfg)
+            self.cfg = cfg
         if cfg.ckpt_io_retries < 0:
             raise ValueError(
                 f"ckpt_io_retries must be >= 0, got {cfg.ckpt_io_retries}"
@@ -189,14 +196,12 @@ class Trainer:
                     "ckpt_write/ckpt_corrupt clauses, or drop --fused_epoch "
                     "for chaos runs (refusing to silently ignore the plan)"
                 )
-        if cfg.sharded_ckpt and cfg.async_ckpt:
-            raise ValueError(
-                "--sharded_ckpt and --async_ckpt are mutually exclusive by "
-                "design: sharding already makes each process's write "
-                "1/n-sized (the serialization the async thread exists to "
-                "overlap), and the manifest commit needs a cross-process "
-                "barrier that a background thread must not hold"
-            )
+        # --sharded_ckpt + --async_ckpt compose (snapshot-then-write): the
+        # step loop blocks only for the device→host snapshot; serialization,
+        # CRC, and the manifest commit run on the background writer, whose
+        # commit barrier is filesystem-based — a jax collective never runs
+        # off the main thread (ckpt/checkpoint.py::AsyncShardedCheckpointer,
+        # docs/checkpointing.md "Two-phase sharded saves")
         # triggered on-device profiling (obs/profile.py): both specs are
         # validated HERE, before any model/data work, so a typo fails in
         # milliseconds rather than after the loaders built
@@ -1132,34 +1137,123 @@ class Trainer:
         )
         return dataclasses.replace(cfg, **overrides)
 
+    def _apply_tune_report(self, cfg: TrainConfig) -> TrainConfig:
+        """``--tune_report``: load the overlap autotuner's report
+        (analysis/overlap.py) and apply its chosen schedule knobs for this
+        config's planner family. A knob flag the user set explicitly
+        (non-default) wins over the report; every applied/overridden knob
+        is printed and exported as a ``tune.*`` gauge at fit() start.
+        A malformed report raises (typed ``TuneReportError``) — silently
+        training untuned against an explicit --tune_report would be a
+        lying flag."""
+        import dataclasses  # noqa: PLC0415
+
+        from tpu_dist.analysis import overlap as overlap_lib  # noqa: PLC0415
+        from tpu_dist.analysis import planner  # noqa: PLC0415
+
+        report = overlap_lib.load_tune_report(cfg.tune_report)
+        family = planner.family_of(
+            grad_compression=cfg.grad_compression,
+            bf16=cfg.bf16,
+            grad_accu_steps=cfg.grad_accu_steps,
+            shard_weight_update=cfg.shard_weight_update,
+            fsdp=cfg.fsdp,
+        )
+        self._tune = {
+            "report": cfg.tune_report,
+            "objective": report.get("objective"),
+            "family": family,
+            "applied": {},
+            "user_overrides": {},
+        }
+        if family is None:
+            rank0_print(
+                "=> tune_report: this flag combination maps to no planner "
+                "family — no tuned knobs to apply"
+            )
+            return cfg
+        knobs = overlap_lib.chosen_knobs(report, family)
+        if not knobs:
+            rank0_print(
+                f"=> tune_report: family {family} — baseline wins, "
+                "no knob overrides"
+            )
+            return cfg
+        defaults = TrainConfig()
+        applied: dict = {}
+        for knob, value in sorted(knobs.items()):
+            if getattr(cfg, knob) != getattr(defaults, knob):
+                # the user set this knob explicitly; the report yields
+                self._tune["user_overrides"][knob] = getattr(cfg, knob)
+                continue
+            applied[knob] = value
+        self._tune["applied"] = applied
+        msg = ", ".join(f"{k}={v}" for k, v in sorted(applied.items()))
+        skipped = ", ".join(
+            f"{k}={v} (user)" for k, v in
+            sorted(self._tune["user_overrides"].items())
+        )
+        rank0_print(
+            f"=> tune_report apply [{family}]: {msg or 'nothing'}"
+            + (f"; kept {skipped}" if skipped else "")
+        )
+        return dataclasses.replace(cfg, **applied) if applied else cfg
+
     def _ckpt_io(self):
         """Sync module functions, the sharded writer (``--sharded_ckpt``),
-        or the async writer (``--async_ckpt``); the async writer is created
-        lazily so each ``fit()`` gets a fresh pool after ``_ckpt_close()``
-        released the previous worker thread."""
-        if self.cfg.sharded_ckpt:
-            # stateless (staticmethods) — hand back the class, same as the
-            # emergency-save path uses it
-            return ckpt_lib.ShardedCheckpointer
+        or an async writer (``--async_ckpt``: plain, or snapshot-then-write
+        sharded when combined with ``--sharded_ckpt``); the async writers
+        are created lazily so each ``fit()`` gets a fresh pool after
+        ``_ckpt_close()`` released the previous worker thread."""
         if not self.cfg.async_ckpt:
+            if self.cfg.sharded_ckpt:
+                # stateless (staticmethods) — hand back the class, same as
+                # the emergency-save path uses it
+                return ckpt_lib.ShardedCheckpointer
             return ckpt_lib
         if self._async_ckpt is None:
-            self._async_ckpt = ckpt_lib.AsyncCheckpointer()
+            self._async_ckpt = (
+                ckpt_lib.AsyncShardedCheckpointer()
+                if self.cfg.sharded_ckpt
+                else ckpt_lib.AsyncCheckpointer()
+            )
         return self._async_ckpt
 
     def _ckpt_close(self, suppress: bool = False) -> None:
-        """Drain + release the async writer. ``suppress=True`` logs a
-        writer error instead of raising — for paths where an exception is
-        already propagating (interrupt/divergence) and must not be masked."""
+        """Bounded drain + release of the async writer
+        (``--ckpt_drain_timeout_s``; ≤0 waits forever). ``suppress=True``
+        logs a writer error instead of raising — for paths where an
+        exception is already propagating (interrupt/divergence) and must
+        not be masked. A drain that times out with writes still in flight
+        is a COUNTED, loud data loss (``ckpt.drain_abandoned``) — never a
+        silent one: the newest data on disk is then the last published
+        (plain) / committed (sharded) checkpoint."""
         if self._async_ckpt is None:
             return
         writer, self._async_ckpt = self._async_ckpt, None
+        timeout = self.cfg.ckpt_drain_timeout_s
+        timeout = timeout if timeout and timeout > 0 else None
         try:
-            writer.close()
+            drained = writer.close(timeout=timeout)
         except Exception as e:
             if not suppress:
                 raise
             rank0_print(f"WARNING: background checkpoint write failed: {e}")
+            return
+        if not drained:
+            n = writer.in_flight
+            counters_lib.inc("ckpt.drain_abandoned", n)
+            rank0_print(
+                f"WARNING: abandoned {n} in-flight background checkpoint "
+                f"write(s) after the {timeout:.0f}s drain timeout "
+                "(--ckpt_drain_timeout_s) — their snapshots are LOST; the "
+                "newest checkpoint on disk is the last one committed"
+            )
+            if not suppress:
+                raise RuntimeError(
+                    f"background checkpoint drain timed out with {n} "
+                    "write(s) in flight (see the warning above)"
+                )
 
     def _build_train_step(self, cfg: TrainConfig, compute_dtype):
         mk = {}
@@ -1184,6 +1278,9 @@ class Trainer:
             param_specs=self._param_specs,
             remat=cfg.remat,
             grad_compression=cfg.grad_compression,
+            quant_chunk=cfg.quant_chunk or None,
+            pmean_fusion=cfg.pmean_fusion,
+            rs_ag_chunks=cfg.rs_ag_chunks,
             device_metrics=cfg.device_metrics,
             model_kwargs=mk or None,
         )
@@ -2624,6 +2721,20 @@ class Trainer:
                         "plan.predicted_step_s", self._plan["predicted_step_s"]
                     )
             history.log("plan", epoch=self.start_epoch, **self._plan)
+        if self._tune is not None:
+            # the --tune_report announcement (satellite of the overlap
+            # autotuner): which schedule knobs the run actually trains
+            # with, as gauges (history + compare can pin a regression to
+            # a knob flip) plus one 'tune' history record
+            if telemetry:
+                counters_lib.set_gauge(
+                    "tune.family", self._tune.get("family") or "none"
+                )
+                for knob, value in sorted(
+                    (self._tune.get("applied") or {}).items()
+                ):
+                    counters_lib.set_gauge(f"tune.{knob}", value)
+            history.log("tune", epoch=self.start_epoch, **self._tune)
         if cfg.heartbeat_file:
             from tpu_dist.obs.heartbeat import (  # noqa: PLC0415
                 Heartbeat, per_rank_path,
@@ -2701,7 +2812,11 @@ class Trainer:
             while True:
                 try:
                     result = self._fit_loop(epochs, history, last)
-                    self._ckpt_close()  # success path: writer errors RAISE
+                    with self._goodput.timed("ckpt"):
+                        # success path: writer errors RAISE; the blocking
+                        # drain of background writes is ckpt time (the
+                        # ledger's sum-to-wall partition stays exact)
+                        self._ckpt_close()
                     if self._heartbeat is not None:
                         # clean exit: the heartbeat's ABSENCE is the signal
                         self._heartbeat.sweep()
