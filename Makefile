@@ -1,6 +1,6 @@
 # Convenience targets; CI / the driver call the underlying commands directly.
 
-.PHONY: test quick bench csrc clean lint shard-report plan-report tune-overlap ckpt-bench pod-report monitor profile-report elastic-drill fleet-drill postmortem-drill serve-drill serve-report memory-report
+.PHONY: test quick bench csrc clean lint shard-report plan-report tune-overlap ckpt-bench pod-report monitor profile-report elastic-drill fleet-drill postmortem-drill serve-drill tenancy-drill serve-report memory-report
 
 csrc:
 	$(MAKE) -C tpu_dist/csrc
@@ -112,6 +112,20 @@ postmortem-drill:
 #   make serve-drill [WORKDIR=/tmp/serve_drill]
 serve-drill:
 	python -m tpu_dist.serve drill --workdir $(or $(WORKDIR),/tmp/serve_drill)
+
+# The co-scheduling proof, locally: one scheduler arbitrates a real
+# training run and a supervised serving replica on the same chip budget
+# through a deterministic diurnal cycle — a traffic spike breaches the
+# serving SLO, training is preempted within the bounded tick count
+# (SIGTERM -> emergency save -> exit 75 -> elastic relaunch on fewer
+# chips, golden-loss parity), availability recovers, and off-peak the
+# trainer reclaims the chips; the replica phase SIGKILLs the serving
+# process and proves crash detection, postmortem bundling, and a
+# bit-exact relaunch; chip-second conservation is audited exactly
+# (docs/resilience.md "Multi-tenant pod"):
+#   make tenancy-drill [WORKDIR=/tmp/tenancy_drill] [PHASE=all|policy|cycle|replica]
+tenancy-drill:
+	python -m tpu_dist.fleet.tenancy_drill --workdir $(or $(WORKDIR),/tmp/tenancy_drill) --phase $(or $(PHASE),all)
 
 # Offline serving SLO report over a run's serve records:
 #   make serve-report LOG=serve.jsonl

@@ -13,6 +13,7 @@ from tpu_dist.train.step import make_train_step
 from tpu_dist.train.trainer import Trainer
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 18): gates in analysis.yml
 def test_dp_tp_sp_training_matches_single_device():
     from jax.sharding import NamedSharding
 

@@ -332,9 +332,9 @@ def test_summarize_skips_unknown_kinds_with_count():
         _goodput_rec("r", 2.0, 2.0, epoch=0, window_s=2.0,
                      productive_s=1.5, unattributed_s=0.5),
         # a future schema's record kinds: skipped, counted, noted
-        {"kind": "hologram", "epoch": 0, "schema_version": 14, "ts": 3.0},
-        {"kind": "hologram", "epoch": 1, "schema_version": 14, "ts": 4.0},
-        {"kind": "quantum_foam", "schema_version": 14, "ts": 5.0},
+        {"kind": "hologram", "epoch": 0, "schema_version": 15, "ts": 3.0},
+        {"kind": "hologram", "epoch": 1, "schema_version": 15, "ts": 4.0},
+        {"kind": "quantum_foam", "schema_version": 15, "ts": 5.0},
     ]
     report = summarize(records)
     assert report["skipped_kinds"] == {"hologram": 2, "quantum_foam": 1}

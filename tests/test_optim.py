@@ -295,6 +295,7 @@ def test_linear_scaling_rule_and_warmup():
     assert multistep_lr(0.8, (10,), 0.1)(0) == pytest.approx(0.8)
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 18): gates in analysis.yml
 def test_trainer_lars_e2e_and_refusals(tmp_path):
     """LARS end-to-end through the Trainer with the full large-batch
     recipe (linear scaling + warmup), plus the two config refusals: the

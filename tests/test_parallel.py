@@ -244,6 +244,7 @@ def test_moe_aux_loss_values():
     assert float(aux_c) > 2.5
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 18): gates in analysis.yml
 def test_moe_aux_loss_threads_through_train_step():
     """vit_moe returns the aux loss in its state; the train step must pop
     it (stable TrainState structure) and fold coef*aux into the loss."""

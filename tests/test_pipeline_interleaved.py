@@ -191,6 +191,7 @@ def test_interleave_rejects_bad_configs():
         )(jnp.zeros((2, 2, 4)))
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 18): gates in analysis.yml
 def test_interleaved_ckpt_refuses_layout_mismatch(tmp_path):
     """Interleaved storage permutes block order on disk — resuming under a
     different pp/pp_interleave must be refused, not run silently wrong."""
@@ -229,6 +230,7 @@ def test_interleave_without_pp_is_refused():
         ))
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 18): gates in analysis.yml
 def test_untagged_ckpt_refused_by_interleaved_resume(tmp_path):
     """A pre-layout-tag checkpoint (logical block order) must not be
     resumed by an interleaved config."""

@@ -436,6 +436,7 @@ def test_cli_plan_text_json_and_inject_miscost(tmp_path):
     )
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 18): gates in analysis.yml
 def test_cli_plan_text_refusal_and_unknown_family(tmp_path):
     # text format with a budget that refuses the dp family
     r = _run_plan_cli(

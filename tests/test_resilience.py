@@ -374,6 +374,29 @@ def test_sigterm_midepoch_emergency_saves_and_resume_is_bit_identical(
     assert _params_equal(jax.device_get(t2.state.params), gparams)
 
 
+def test_sigterm_after_final_step_replays_the_epoch_record(tmp_path, golden):
+    """The nastiest preemption point: SIGTERM lands after the epoch's LAST
+    step, so the resumed epoch has zero steps left.  The snapshot stamps the
+    final step's fetched metrics (``mid_epoch_metrics``) and the resume
+    replays them, so the epoch record still matches the uninterrupted run
+    bit-for-bit instead of being logged without a loss."""
+    gparams, glast = golden
+    d = str(tmp_path)
+    cfg = _cfg(d, fault_plan="sigterm@epoch=1:step=2")
+    with pytest.raises(PreemptedError):
+        Trainer(cfg).fit()
+    found = latest_checkpoint(d)
+    assert found is not None and found[1] == 1
+    meta = read_meta(found[0])
+    assert meta["mid_epoch_step"] == 3  # every step of the epoch ran
+    assert meta["mid_epoch_metrics"]["loss"] == glast["loss"]
+    t2 = Trainer(cfg.replace(fault_plan=None, resume=True))
+    assert t2.start_epoch == 1 and t2._resume_step == 3
+    last = t2.fit()  # zero steps remain: the record is replayed, not empty
+    assert last["loss"] == glast["loss"]
+    assert _params_equal(jax.device_get(t2.state.params), gparams)
+
+
 def test_cli_maps_preemption_to_distinct_exit_code(tmp_path):
     from tpu_dist.cli.train import main
 

@@ -1,0 +1,474 @@
+"""Multi-tenant pod: SLO-aware train+serve co-scheduling
+(docs/resilience.md "Multi-tenant pod").
+
+The asymmetric policy units (a sustained serve SLO breach preempts
+training chips within the bounded tick window; a sustained-healthy
+serve run releases its surplus back off-peak; floors, liveness and the
+non-SLO alert veto hold on both paths; hysteresis streaks kill thrash),
+the preemption-latency contract on a manual clock, the serve-gauge
+scrape through ``read_signals`` (including the garbage-heartbeat
+fail-closed), vacate-window load shedding vs a queue explosion, the
+chip-second conservation audit, the TD122 traced-noop gate (with its
+vacuity guard), and the ``tenancy_drill`` policy phase.
+
+The jax-subprocess phases (the real-trainer diurnal cycle and the
+SIGKILL'd supervised replica) are slow-marked; ``make tenancy-drill``
+runs all three.
+"""
+
+import inspect
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpu_dist.fleet.scheduler import (
+    FLEET_SCHEMA_VERSION,
+    FleetPolicy,
+    FleetScheduler,
+    RunSignals,
+    RunSpec,
+    audit_chip_seconds,
+    read_signals,
+)
+from tpu_dist.obs import counters as counters_lib
+from tpu_dist.resilience import faults, preemption
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    preemption.clear()
+    counters_lib.reset()
+    yield
+    faults.clear()
+    preemption.clear()
+    counters_lib.reset()
+
+
+def _train_sig(run, stall=0.02, alive=True, alerts=()):
+    return RunSignals(
+        run=run, data_stall_frac=stall, goodput_frac=0.9, mfu=0.4,
+        active_alerts=tuple(alerts), alive=alive,
+    )
+
+
+def _serve_sig(run, queue, avail=1.0, alerts=(), alive=True, p99=5.0):
+    return RunSignals(
+        run=run, active_alerts=tuple(alerts), alive=alive,
+        queue_depth=float(queue), availability=avail, latency_p99_ms=p99,
+    )
+
+
+def _pod(**kw):
+    args = dict(
+        runs=[
+            RunSpec("tr", 8, min_procs=2),
+            RunSpec("sv", 4, min_procs=1, kind="serve"),
+        ],
+        allocations={"tr": 8, "sv": 2},
+        total_chips=11,  # 1 chip free: not enough for sv's 2->4 alone
+    )
+    args.update(kw)
+    return FleetScheduler(**args)
+
+
+# -- asymmetric policy: the breach path --------------------------------------
+
+
+def test_run_kind_validated():
+    assert RunSpec("s", 4, kind="serve").kind == "serve"
+    with pytest.raises(ValueError, match="kind"):
+        RunSpec("s", 4, kind="batch")
+
+
+def test_sustained_breach_preempts_training_within_bound():
+    """The preemption-latency contract: the FIRST breach reading starts
+    the streak; the donate fires the tick the streak crosses
+    ``serve_breach_ticks`` (spike_tick + serve_breach_ticks - 1); the
+    chips land one tick later. The trainer is preempted even though it
+    is compute-bound — the SLO outranks goodput."""
+    s = _pod()
+    tr = _train_sig("tr")
+    # tick 1: off-peak — establishes the queue baseline
+    assert s.step(1, {"tr": tr, "sv": _serve_sig("sv", 0)}) == []
+    # tick 2 (the spike): queue jumps 0->6 (growth >= 1.0 is a breach
+    # reading) — the streak arms but one reading never moves chips
+    spike_tick = 2
+    assert s.step(spike_tick, {
+        "tr": tr, "sv": _serve_sig("sv", 6, avail=0.8),
+    }) == []
+    # tick 3: still exploding + an slo_* alert — streak hits the bar
+    [d] = s.step(spike_tick + 1, {
+        "tr": tr,
+        "sv": _serve_sig("sv", 9, avail=0.8, alerts=("slo_availability_low",)),
+    })
+    assert d["action"] == "donate" and d["preempt"] is True
+    assert d["donor"] == "tr" and d["for_run"] == "sv"
+    assert d["alloc_after"] == {"tr": 4, "sv": 2}  # sv NOT grown yet
+    assert spike_tick + 1 == spike_tick + s.policy.serve_breach_ticks - 1
+    assert "SLO breach" in d["reason"]
+    # tick 4: the freed chips matured — the grant lands, bound proven
+    [g] = s.step(spike_tick + 2, {
+        "tr": tr,
+        "sv": _serve_sig("sv", 12, avail=0.8, alerts=("slo_p99_high",)),
+    })
+    assert g["action"] == "grant" and g["preempt"] is True
+    assert g["recipient"] == "sv"
+    assert s.alloc == {"sv": 4, "tr": 4}
+    assert s.preemptions == 2  # the donate and the grant legs
+    assert "tpu_dist_fleet_preemptions 2" in s.exposition()
+
+
+def test_preemption_ignores_donor_cooldown_but_honors_floor():
+    # cooldown: tr just moved — the goodput market would sit out, the
+    # SLO path must not (a cooldown inside the latency bound is a lie)
+    breach = lambda: _serve_sig("sv", 9, avail=0.8, alerts=("slo_p99_high",))
+    s = _pod()
+    s._last_move_tick["tr"] = 2  # cooldown covers ticks 3 and 4
+    s.step(2, {"tr": _train_sig("tr"), "sv": breach()})
+    [d] = s.step(3, {"tr": _train_sig("tr"), "sv": breach()})
+    assert d["action"] == "donate" and d["preempt"] is True
+    # floor: a trainer AT min_procs is never preempted below it
+    s2 = _pod(
+        runs=[
+            RunSpec("tr", 8, min_procs=8),
+            RunSpec("sv", 4, min_procs=1, kind="serve"),
+        ],
+    )
+    s2.step(1, {"tr": _train_sig("tr"), "sv": breach()})
+    assert s2.step(2, {"tr": _train_sig("tr"), "sv": breach()}) == []
+
+
+def test_breach_vetoes_dead_heartbeat_and_non_slo_alert():
+    # a dead serve heartbeat never attracts chips (they can't help)
+    s = _pod()
+    dead = lambda: _serve_sig(
+        "sv", 9, avail=0.8, alerts=("slo_p99_high",), alive=False,
+    )
+    s.step(1, {"tr": _train_sig("tr"), "sv": dead()})
+    assert s.step(2, {"tr": _train_sig("tr"), "sv": dead()}) == []
+    # a non-SLO alert (sick replica) vetoes the grow even mid-breach
+    s2 = _pod()
+    sick = lambda: _serve_sig(
+        "sv", 9, avail=0.8, alerts=("slo_p99_high", "serve_retrace"),
+    )
+    s2.step(1, {"tr": _train_sig("tr"), "sv": sick()})
+    assert s2.step(2, {"tr": _train_sig("tr"), "sv": sick()}) == []
+    # a dead TRAINER can't be the preemption donor either
+    s3 = _pod()
+    breach = lambda: _serve_sig("sv", 9, avail=0.8, alerts=("slo_p99_high",))
+    s3.step(1, {"tr": _train_sig("tr", alive=False), "sv": breach()})
+    assert s3.step(2, {
+        "tr": _train_sig("tr", alive=False), "sv": breach(),
+    }) == []
+
+
+def test_hysteresis_streaks_prevent_thrash():
+    """Alternating breach/clean readings never cross either streak bar:
+    no donate, no grant, no release — the pod does not thrash."""
+    s = _pod(allocations={"tr": 4, "sv": 4})
+    tr = _train_sig("tr")
+    for tick in range(1, 9):
+        if tick % 2:
+            sv = _serve_sig("sv", 6 + tick, avail=0.9)  # growing queue
+        else:
+            sv = _serve_sig("sv", 0, avail=1.0)  # clean and idle
+        assert s.step(tick, {"tr": tr, "sv": sv}) == []
+    assert s.preemptions == 0
+
+
+# -- asymmetric policy: the off-peak release path ----------------------------
+
+
+def test_offpeak_release_returns_chips_to_compute_bound_trainer():
+    s = _pod(allocations={"tr": 4, "sv": 4}, total_chips=11)
+    tr = _train_sig("tr", stall=0.02)  # compute-bound: wants chips
+    idle = lambda: _serve_sig("sv", 0, avail=1.0)
+    # healthy streak must reach serve_release_ticks (3) first
+    assert s.step(1, {"tr": tr, "sv": idle()}) == []
+    assert s.step(2, {"tr": tr, "sv": idle()}) == []
+    [d] = s.step(3, {"tr": tr, "sv": idle()})
+    assert d["action"] == "donate" and not d.get("preempt")
+    assert d["donor"] == "sv" and d["for_run"] == "tr"
+    assert "healthy" in d["reason"]
+    assert s.alloc == {"sv": 2, "tr": 4}
+    [g] = s.step(4, {"tr": tr, "sv": idle()})
+    assert g["action"] == "grant" and g["recipient"] == "tr"
+    assert s.alloc == {"sv": 2, "tr": 8}
+    assert s.preemptions == 0  # off-peak reclaim is NOT a preemption
+
+
+def test_release_needs_idle_queue_availability_and_floor():
+    tr = _train_sig("tr", stall=0.02)
+    # busy-but-within-SLO (queue above idle bar): holds its chips
+    s = _pod(allocations={"tr": 4, "sv": 4})
+    for tick in range(1, 6):
+        assert s.step(tick, {
+            "tr": tr, "sv": _serve_sig("sv", 3, avail=1.0),
+        }) == []
+    # availability under the bar: holds its chips
+    s2 = _pod(allocations={"tr": 4, "sv": 4})
+    for tick in range(1, 6):
+        assert s2.step(tick, {
+            "tr": tr, "sv": _serve_sig("sv", 0, avail=0.95),
+        }) == []
+    # at its floor: nothing to release no matter how idle
+    s3 = _pod(
+        runs=[
+            RunSpec("tr", 8, min_procs=2),
+            RunSpec("sv", 4, min_procs=4, kind="serve"),
+        ],
+        allocations={"tr": 4, "sv": 4},
+    )
+    for tick in range(1, 6):
+        assert s3.step(tick, {
+            "tr": tr, "sv": _serve_sig("sv", 0, avail=1.0),
+        }) == []
+
+
+# -- the serve-gauge scrape (read_signals) -----------------------------------
+
+
+def _write(path, text):
+    with open(path, "w") as f:
+        f.write(text)
+
+
+def _serve_prom(tmp_path, **gauges):
+    from tpu_dist.obs import export as export_lib
+
+    prom = str(tmp_path / "metrics.prom")
+    alerts = gauges.pop("alerts", {})
+    _write(prom, export_lib.render(gauges, {"alert_active": alerts}))
+    return prom
+
+
+def test_read_signals_scrapes_serve_gauges(tmp_path):
+    prom = _serve_prom(
+        tmp_path,
+        **{
+            "serve.queue_depth": 7.0,
+            "serve.availability": 0.875,
+            "serve.latency_p99_ms": 612.5,
+            "alerts": {"slo_p99_high": 1.0, "grad_norm_high": 0.0},
+        },
+    )
+    sig = read_signals("sv", prom)
+    assert sig.queue_depth == 7.0
+    assert sig.availability == 0.875
+    assert sig.latency_p99_ms == 612.5
+    assert sig.active_alerts == ("slo_p99_high",)  # only the FIRING one
+
+
+def test_read_signals_garbage_heartbeat_fails_closed(tmp_path):
+    """A heartbeat that is unreadable, missing, or carries no usable
+    timestamp is indistinguishable from a dead run — it must scrape as
+    alive=False (fail closed), never as unknown: ``alive=None`` would
+    keep the run grant-eligible on evidence that says nothing."""
+    import time as time_lib
+
+    prom = _serve_prom(tmp_path, **{"serve.queue_depth": 1.0})
+    hb = str(tmp_path / "hb.json")
+    _write(hb, "{not json")
+    assert read_signals("sv", prom, heartbeat_file=hb).alive is False
+    _write(hb, json.dumps({"ts": "soon", "phase": "serve"}))  # garbage ts
+    assert read_signals("sv", prom, heartbeat_file=hb).alive is False
+    assert read_signals(
+        "sv", prom, heartbeat_file=str(tmp_path / "absent.json"),
+    ).alive is False
+    # a fresh, well-formed beat reads alive
+    _write(hb, json.dumps({"ts": time_lib.time(), "phase": "serve"}))
+    sig = read_signals("sv", prom, heartbeat_file=hb)
+    assert sig.alive is True and sig.heartbeat_age_s is not None
+    # no heartbeat contracted at all: liveness stays unknown
+    assert read_signals("sv", prom).alive is None
+
+
+def test_export_key_gauges_include_serving_rows():
+    from tpu_dist.obs.export import KEY_GAUGES
+
+    names = [raw for raw, _, _ in KEY_GAUGES]
+    for want in ("serve.queue_depth", "serve.availability",
+                 "serve.latency_p99_ms"):
+        assert want in names
+
+
+# -- vacate-window shedding vs a queue explosion -----------------------------
+
+
+class _NoopModel:
+    classes = 10
+
+    def init(self, key):
+        return {}, {}
+
+    def apply(self, params, state, x, **kw):
+        return x, state
+
+
+def test_shed_refuses_at_admission_and_stays_off_the_histograms():
+    from tpu_dist.serve.engine import ServingEngine
+
+    eng = ServingEngine(_NoopModel(), {}, {}, max_batch=4, max_queue=2)
+    one = np.zeros((4,), np.float32)
+    a, b = eng.submit(one), eng.submit(one)
+    assert a.ok is False and b.ok is False  # queued, not yet completed
+    # the cap: request 3 bounces instead of exploding the queue
+    refused = eng.submit(one)
+    assert refused.ok is False and refused.result is None
+    assert eng.queue_depth() == 2
+    # vacate-window shedding refuses EVERYTHING at admission
+    eng.set_shedding(True)
+    assert eng.shedding is True
+    shed = eng.submit(one)
+    assert shed.ok is False and eng.queue_depth() == 2
+    sc = eng.stats.scalars()
+    assert sc["serve.requests"] == 2  # admitted work only
+    assert sc["serve.shed"] == 2
+    assert counters_lib.get("serve.shed") == 2
+    # shed requests never reach the latency histograms
+    assert all(
+        fam["count"] == 0 for fam in eng.stats.histogram_families().values()
+    )
+    eng.stats.check_invariants()
+
+
+def test_pump_beats_heartbeat_even_idle(tmp_path):
+    from tpu_dist.obs import heartbeat as hb_lib
+    from tpu_dist.serve.engine import ServingEngine
+
+    hb = str(tmp_path / "hb.json")
+    eng = ServingEngine(
+        _NoopModel(), {}, {}, max_batch=2, heartbeat_file=hb,
+    )
+    assert eng.pump() == []  # empty queue: no batch...
+    rec = hb_lib.read(hb)
+    assert rec is not None and rec["phase"] == "serve"  # ...but a beat
+
+
+# -- chip-second conservation ------------------------------------------------
+
+
+def test_chip_second_conservation_exact_and_tamper_detected(tmp_path):
+    s = _pod(fleet_dir=str(tmp_path))
+    tr = _train_sig("tr")
+    ticks = [
+        _serve_sig("sv", 0), _serve_sig("sv", 6), _serve_sig("sv", 9),
+        _serve_sig("sv", 12, alerts=("slo_p99_high",)),
+        _serve_sig("sv", 2), _serve_sig("sv", 0),
+    ]
+    for tick, sv in enumerate(ticks, start=1):
+        s.step(tick, {"tr": tr, "sv": sv}, ts=float(tick))
+    recs = [json.loads(l) for l in open(s.history_path())]
+    tenancy = [r for r in recs if r.get("kind") == "tenancy"]
+    assert len(tenancy) == len(ticks)  # exactly one ledger row per tick
+    assert all(r["schema_version"] == FLEET_SCHEMA_VERSION for r in tenancy)
+    audit = audit_chip_seconds(tenancy, tick_s=2.0)
+    assert audit["conserved"] is True and audit["violations"] == []
+    assert audit["accounted_chip_s"] == audit["pod_chip_s"]
+    assert audit["pod_chip_s"] == 11 * len(ticks) * 2.0
+    assert audit["n_ticks"] == len(ticks)
+    # the identity is an equality, not a bound: losing OR inventing a
+    # chip for one tick is a violation that names the tick
+    for delta in (-1, 1):
+        bad = [dict(r) for r in tenancy]
+        bad[3] = dict(bad[3], free=bad[3]["free"] + delta)
+        tampered = audit_chip_seconds(bad)
+        assert tampered["conserved"] is False
+        assert [v["tick"] for v in tampered["violations"]] == [bad[3]["tick"]]
+
+
+def test_audit_rejects_records_from_a_different_pod():
+    """Mixing snapshots from two schedulers (different pod sizes) can
+    never balance — the identity is per-pod, not best-effort."""
+    s = _pod()
+    tr = _train_sig("tr")
+    for tick in range(1, 4):
+        s.step(tick, {"tr": tr, "sv": _serve_sig("sv", 0)})
+    rows = [s.tenancy_record(t) for t in (1, 2, 3)]
+    rows[1] = dict(rows[1], total_chips=12)  # a 12-chip pod's row
+    audit = audit_chip_seconds(rows)
+    assert audit["conserved"] is False
+    # non-tenancy kinds are ignored, not miscounted
+    ok = audit_chip_seconds(
+        [{"kind": "fleet", "action": "grant"}] + [
+            s.tenancy_record(t) for t in (1, 2, 3)
+        ]
+    )
+    assert ok["conserved"] is True and ok["n_ticks"] == 3
+
+
+# -- TD122: tenancy arbitration is control-plane only ------------------------
+
+
+def test_td122_registered_and_audit_all_wired():
+    from tpu_dist.analysis import jaxpr_audit
+    from tpu_dist.analysis.rules import RULES
+
+    assert "TD122" in RULES
+    assert RULES["TD122"].name == "tenancy-arbitration-control-plane-only"
+    assert "tenancy_arbitration_noop_violations" in inspect.getsource(
+        jaxpr_audit.audit_all
+    )
+
+
+def test_td122_gate_tenancy_arbitration_is_noop():
+    from tpu_dist.analysis.jaxpr_audit import (
+        tenancy_arbitration_noop_violations,
+    )
+
+    assert tenancy_arbitration_noop_violations() == []
+
+
+def test_td122_probe_is_vacuity_guarded(monkeypatch):
+    """A kit that cannot fire proves nothing: gut the scheduler so the
+    preemption never happens and the probe must REPORT, not pass — the
+    dead-detector contract behind ``analysis.__main__``'s exit 2."""
+    from tpu_dist.analysis.jaxpr_audit import (
+        tenancy_arbitration_noop_violations,
+    )
+    from tpu_dist.fleet import scheduler as fleet_lib
+
+    monkeypatch.setattr(
+        fleet_lib.FleetScheduler, "decide", lambda self, tick, sig: []
+    )
+    vs = tenancy_arbitration_noop_violations()
+    assert len(vs) == 1 and vs[0].rule == "TD122"
+    assert "vacuous" in vs[0].message
+
+
+# -- the drill ---------------------------------------------------------------
+
+
+def test_tenancy_drill_policy_phase(tmp_path):
+    from tpu_dist.fleet.tenancy_drill import main as drill_main
+
+    assert drill_main(
+        ["--workdir", str(tmp_path), "--phase", "policy"]
+    ) == 0
+
+
+@pytest.mark.slow
+def test_tenancy_drill_replica_phase(tmp_path):
+    """SIGKILL a supervised serving replica: crash detected, postmortem
+    bundled, relaunch restores bit-exact weights and resumes serving
+    with zero post-warmup retraces (jax subprocesses)."""
+    from tpu_dist.fleet.tenancy_drill import main as drill_main
+
+    assert drill_main(
+        ["--workdir", str(tmp_path), "--phase", "replica"]
+    ) == 0
+
+
+@pytest.mark.slow
+def test_tenancy_drill_cycle_phase(tmp_path):
+    """The full diurnal day against a REAL trainer: spike -> bounded
+    preemption -> lossless shrink -> recovery -> off-peak reclaim ->
+    golden-rtol losses and exact chip-second conservation."""
+    from tpu_dist.fleet.tenancy_drill import main as drill_main
+
+    assert drill_main(
+        ["--workdir", str(tmp_path), "--phase", "cycle"]
+    ) == 0

@@ -1471,6 +1471,197 @@ def memory_ledger_noop_violations(mesh=None) -> list[Violation]:
     return out
 
 
+def tenancy_arbitration_noop_violations(mesh=None) -> list[Violation]:
+    """TD122: the multi-tenancy cost contract, checked at the program
+    level (the TD105-TD121 armed-vs-off discipline applied to the
+    train+serve co-scheduling plane) — trace the data-parallel train
+    step AND the serving forward step with nothing armed, then arm the
+    FULL tenancy kit exactly as a co-scheduled pod runs it: a breached
+    serve exposition (fired ``slo_*`` alerts, queue/availability/p99
+    gauges, latency histograms) rendered to disk and scraped back
+    through the fleet sensor path (``read_signals``), a kind-aware
+    :class:`FleetScheduler` driven through a SUSTAINED breach to a
+    genuinely fired ``preempt=True`` donate→grant pair, the cooperative
+    SIGTERM flag raised through the installed handler, a live
+    :class:`ServingEngine` refusing work under shedding admission, and
+    the per-tick chip-second conservation audit — and trace both steps
+    again WHILE the preemption flag is up and shedding is on. Both
+    jaxprs must be byte-identical: arbitration is host arithmetic over
+    scraped files and allocation integers, and the moment someone
+    routes a preemption check or an SLO probe through a compiled step,
+    this trips. The probe also asserts the kit actually RAN (the scrape
+    round-tripped the serve gauges, the preemption decision fired and
+    the chips landed, the flag was observed, a request was actually
+    shed, the chip-second books balance exactly) — a dead arbiter would
+    make the comparison vacuous."""
+    import os
+    import signal as signal_lib
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.fleet import scheduler as fleet_lib
+    from tpu_dist.obs import export as export_lib
+    from tpu_dist.obs import heartbeat as heartbeat_lib
+    from tpu_dist.resilience import preemption
+    from tpu_dist.serve import slo as slo_lib
+    from tpu_dist.serve.engine import ServingEngine
+
+    m = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+    fn, args = _dp_setup(m, shard_weight_update=True)
+    base_train = str(jax.make_jaxpr(fn)(*args))
+
+    model = _AuditMLP()
+    params, bn = model.init(jax.random.PRNGKey(0))
+    x = jax.ShapeDtypeStruct((8, 2, 2, 3), jnp.float32)
+
+    def forward(p, s, images):
+        logits, _ = model.apply(p, s, images, train=False)
+        return logits
+
+    base_serve = str(jax.make_jaxpr(forward)(params, bn, x))
+
+    # -- arm: a genuinely breached serve run, scraped off disk --------------
+    stats = slo_lib.ServeStats(deadline_s=0.05)
+    slo_engine = slo_lib.make_slo_engine(slo_lib.load_slo_rules("default"))
+    fired: list = []
+    window: dict = {}
+    for _ in range(3):  # sustain=2 rules genuinely sustain
+        for _ in range(4):
+            stats.on_batch(3, 4)
+            # 600 ms: breaches slo_p99_high AND the 50 ms deadline
+            stats.on_request_done(
+                0.6, 0.45, {p: 0.1 for p in slo_lib.PHASES}
+            )
+        stats.set_queue_depth(6)
+        window = stats.scalars(window_s=1.0, completed_in_window=4)
+        fired.extend(slo_engine.observe(window))
+    with tempfile.TemporaryDirectory(prefix="td122_") as td:
+        prom = os.path.join(td, "metrics.prom")
+        with open(prom, "w") as f:
+            f.write(export_lib.render(
+                window,
+                {"alert_active": slo_engine.active()},
+                histograms=stats.histogram_families(),
+            ))
+        hb_path = os.path.join(td, "hb.json")
+        heartbeat_lib.Heartbeat(hb_path).beat(force=True)
+        sig = fleet_lib.read_signals("svc", prom, heartbeat_file=hb_path)
+
+    # -- arm: the kind-aware arbiter, driven to a fired preemption ----------
+    sched = fleet_lib.FleetScheduler(
+        [
+            fleet_lib.RunSpec("trainer", 8, min_procs=2, kind="train"),
+            fleet_lib.RunSpec("svc", 4, min_procs=1, kind="serve"),
+        ],
+        allocations={"trainer": 8, "svc": 2},
+    )
+    signals = {
+        "trainer": fleet_lib.RunSignals(
+            run="trainer", data_stall_frac=0.02, goodput_frac=0.9,
+            alive=True,
+        ),
+        "svc": sig,
+    }
+    decisions: list = []
+    tenancy: list = []
+    for t in range(1, 5):
+        decisions.extend(sched.step(t, signals))
+        tenancy.append(sched.tenancy_record(t))
+    audit = fleet_lib.audit_chip_seconds(tenancy)
+
+    # -- arm: the cooperative SIGTERM flag + shedding admission -------------
+    token = preemption.install()
+    engine = ServingEngine(model, params, bn, max_batch=4, max_queue=2)
+    try:
+        if signal_lib.getsignal(signal_lib.SIGTERM) is preemption._handler:
+            signal_lib.raise_signal(signal_lib.SIGTERM)
+        else:  # audit driven off the main thread: no handler installed
+            preemption._handler(signal_lib.SIGTERM, None)
+        flag_fired = preemption.requested()
+        engine.set_shedding(True, "vacate (TD122 probe)")
+        refused = engine.submit(np.zeros((2, 2, 3), np.float32))
+        shed_ok = (
+            not refused.ok
+            and engine.stats.shed == 1
+            and engine.queue_depth() == 0
+        )
+        # re-trace with the WHOLE kit up: flag raised, shedding on,
+        # arbiter holding post-preemption state
+        fn2, args2 = _dp_setup(m, shard_weight_update=True)
+        armed_train = str(jax.make_jaxpr(fn2)(*args2))
+        armed_serve = str(jax.make_jaxpr(forward)(params, bn, x))
+    finally:
+        engine.set_shedding(False)
+        preemption.clear()
+        preemption.restore(token)
+
+    out: list[Violation] = []
+    ran = (
+        sig.queue_depth == 6.0
+        and sig.alive is True
+        and any(a.startswith("slo_") for a in sig.active_alerts)
+        and fired
+        and any(d.get("preempt") for d in decisions)
+        and sched.preemptions >= 2  # the donate AND the grant
+        and sched.alloc == {"trainer": 4, "svc": 4}
+        and flag_fired
+        and shed_ok
+        and audit["conserved"]
+    )
+    if not ran:
+        out.append(
+            Violation(
+                "TD122",
+                "<jaxpr:tenancy_arbitration_noop>",
+                0,
+                "the TD122 probe armed the tenancy arbitration kit but "
+                "it did not actually run (serve gauges failed to scrape, "
+                "no slo_* alert fired, the preemption decision never "
+                "fired or the chips never landed, the SIGTERM flag was "
+                "not observed, no request was shed, or the chip-second "
+                "books failed to balance) — the armed-vs-off comparison "
+                "would be vacuous (tpu_dist/fleet/scheduler.py contract)",
+                snippet="tenancy arbitration probe did not fire",
+            )
+        )
+    if base_train != armed_train:
+        out.append(
+            Violation(
+                "TD122",
+                "<jaxpr:tenancy_arbitration_noop>",
+                0,
+                "the traced train step CHANGED when the multi-tenant "
+                "arbitration kit was armed (serve scrape, kind-aware "
+                "policy, fired preemption, SIGTERM flag, shedding "
+                "admission) — co-scheduling must stay host-side control-"
+                "plane arithmetic around the unmodified compiled step "
+                "(tpu_dist/fleet/scheduler.py contract, "
+                "docs/resilience.md 'Multi-tenant pod')",
+                snippet="jaxpr(train, tenancy_off) != jaxpr(train, tenancy_armed)",
+            )
+        )
+    if base_serve != armed_serve:
+        out.append(
+            Violation(
+                "TD122",
+                "<jaxpr:tenancy_arbitration_noop>",
+                0,
+                "the traced serving forward step CHANGED when the multi-"
+                "tenant arbitration kit was armed — a replica under an "
+                "active vacate (flag up, shedding on) must serve the "
+                "SAME compiled program it warmed, or the drain window "
+                "retraces exactly when latency matters most "
+                "(tpu_dist/serve/engine.py contract, docs/serving.md)",
+                snippet="jaxpr(serve, tenancy_off) != jaxpr(serve, tenancy_armed)",
+            )
+        )
+    return out
+
+
 def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     """Run every (or the named) registered case. Returns
     ``(report, violations)`` where report maps case → op counts.
@@ -1479,8 +1670,8 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     the TD105 fault-injection, TD106 telemetry, TD107 device-metrics,
     TD108 profiler-trigger, TD109 live-export/alerting, TD110
     capture-auto-analyze, TD111 elastic-resume, TD112 elastic-grow,
-    TD113 flight-recorder, TD114 serving-SLO, and TD115 memory-ledger
-    no-op invariants."""
+    TD113 flight-recorder, TD114 serving-SLO, TD115 memory-ledger, and
+    TD122 tenancy-arbitration no-op invariants."""
     report: dict = {}
     violations: list[Violation] = []
     for name in names if names is not None else registered_cases():
@@ -1521,6 +1712,9 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
         violations.extend(vs)
         vs = memory_ledger_noop_violations(mesh)
         report["dp_memory_ledger_noop"] = {"identical": not vs}
+        violations.extend(vs)
+        vs = tenancy_arbitration_noop_violations(mesh)
+        report["tenancy_arbitration_noop"] = {"identical": not vs}
         violations.extend(vs)
     return report, violations
 

@@ -289,6 +289,20 @@ RULES: dict[str, Rule] = {
             "(tpu_dist/analysis/overlap.py, docs/analysis.md)",
         ),
         Rule(
+            "TD122",
+            "tenancy-arbitration-control-plane-only",
+            "the traced train step or the jitted serving forward CHANGED "
+            "when the multi-tenant arbitration kit was armed (serve-gauge "
+            "scrape through read_signals, kind-aware fleet policy driven "
+            "to a genuinely fired SLO preemption, the cooperative SIGTERM "
+            "flag raised, load-shedding admission refusing work) — "
+            "train/serve co-scheduling must stay host-side control-plane "
+            "arithmetic around the unmodified compiled programs, and a "
+            "probe where the preemption never fires is vacuous "
+            "(tpu_dist/fleet/scheduler.py, tpu_dist/serve/engine.py, "
+            "docs/resilience.md 'Multi-tenant pod')",
+        ),
+        Rule(
             "TD104",
             "quantized-wire-bytes-over-budget",
             "gradient-collective payload bytes of a quantized wire format "

@@ -10,23 +10,42 @@ original submission happened to put them. This module is the arbiter:
 * **Sensors** — the per-run signals the obs stack already exports:
   each run's OpenMetrics textfile (``--metrics_file``; scraped with
   ``obs/export.py::scrape``) carries data-stall fraction, goodput
-  fraction, MFU and the active-alert gauges, and its heartbeat file
+  fraction, MFU, the serving gauges (queue depth, availability, p99
+  latency bound) and the active-alert gauges, and its heartbeat file
   answers liveness. Nothing here instruments a run — the scheduler is a
   pure reader of artifacts that exist anyway.
-* **Policy** (:meth:`FleetScheduler.decide`) — at epoch-grain decision
-  points (integer ``tick``), a run data-stalled past
-  ``donate_stall_frac`` donates chips toward a compute-bound one under
-  ``receive_stall_frac``. Donated chips are **pending until the next
-  tick**: the donor needs its checkpoint→relaunch window to actually
-  vacate them, so granting in the same instant would transiently
-  oversubscribe the pool — the recipient is granted from the FREE pool
-  only, one tick later. Hysteresis (a run that just received must
-  breach the donate threshold by an extra margin before donating back,
-  and vice versa) plus a per-run move cooldown keep allocations from
-  thrashing; a run with active alerts or a stale heartbeat is vetoed
-  from receiving; a donor never drops below its ``min_procs`` floor.
-  The function is pure: (state, tick, signals) → decisions, no clock —
-  every decision is reproducible from its recorded inputs.
+* **Policy** (:meth:`FleetScheduler.decide`) — the pod is
+  multi-tenant: each :class:`RunSpec` carries a ``kind`` (``train`` or
+  ``serve``) and the policy is deliberately **asymmetric**. Training
+  runs trade chips on goodput: at epoch-grain decision points (integer
+  ``tick``), a run data-stalled past ``donate_stall_frac`` donates
+  chips toward a compute-bound one under ``receive_stall_frac``.
+  Serving runs trade chips on SLO: a serving SLO breach sustained for
+  ``serve_breach_ticks`` readings (any active ``slo_*`` alert, or
+  queue-depth growth across consecutive readings) **preempts** training
+  chips — the breached replica set is granted from the free pool when
+  chips are vacant, otherwise a training donor is shrunk *regardless of
+  its stall fraction* (the SLO outranks goodput; ``min_procs`` floors
+  and shrink feasibility still hold) — and once the breach clears for
+  ``serve_release_ticks`` readings the serve run donates its surplus
+  back so training soaks everything idle off-peak. Donated chips are
+  **pending until the next tick**: the donor needs its
+  checkpoint→relaunch window to actually vacate them, so granting in
+  the same instant would transiently oversubscribe the pool — the
+  recipient is granted from the FREE pool only, one tick later.
+  Hysteresis (a run that just received must breach the donate
+  threshold by an extra margin before donating back, and vice versa;
+  the serve breach/release streaks play the same role for serve runs)
+  plus a per-run move cooldown keep allocations from thrashing; a run
+  with active alerts or a stale heartbeat is vetoed from receiving —
+  except that on a SERVE run the ``slo_*`` alerts are the *demand
+  signal*, not sickness, so only non-SLO alerts (e.g.
+  ``serve_retrace``) veto a serve grant; a donor never drops below its
+  ``min_procs`` floor. The function is pure: (state, tick, signals) →
+  decisions, no clock — every decision is reproducible from its
+  recorded inputs (the breach/release streak state is derived
+  deterministically from the signal sequence by
+  :meth:`FleetScheduler.note_signals`).
 * **Actuator** — a decision writes the runs' allocation files
   (``fleet/capacity.py``); each run's elastic supervisor probe picks the
   change up and rides the proven path (donor: SIGTERM → checkpoint →
@@ -37,7 +56,14 @@ original submission happened to put them. This module is the arbiter:
   allocations before/after AND the full signal inputs that justified
   the move, plus ``fleet.allocation.<run>`` gauges / ``fleet.decisions``
   counter and an optional OpenMetrics exposition
-  (``tpu_dist_fleet_allocation{run="..."}``).
+  (``tpu_dist_fleet_allocation{run="..."}``). Additionally every
+  :meth:`FleetScheduler.step` appends one ``tenancy`` record — a
+  per-tick snapshot of every run's allocation plus the free and
+  pending pools — so chip-second accounting is **exact by
+  construction**: at every tick ``sum(alloc) + free + pending ==
+  total_chips`` (a scheduler invariant), hence summed over N ticks the
+  per-run buckets ∪ the scheduler's own free/pending audit equal
+  ``total_chips × N`` exactly (:func:`audit_chip_seconds`).
 
 Stdlib-only (no jax): the arbiter runs wherever the metrics files are
 visible — the pod's controller VM, a laptop over a mount.
@@ -60,11 +86,15 @@ from tpu_dist.fleet import capacity as capacity_lib
 from tpu_dist.obs import counters as counters_lib
 from tpu_dist.obs import export as export_lib
 
-#: ``fleet`` records stamp the CURRENT history schema (metrics/
-#: history.py — v13 after the additive ``tune`` kind). Kept as a
-#: literal so this module stays jax-free; ``tests/test_fleet.py`` pins
-#: it to the real SCHEMA_VERSION so the two can never drift silently.
-FLEET_SCHEMA_VERSION = 13
+#: ``fleet``/``tenancy`` records stamp the CURRENT history schema
+#: (metrics/history.py — v14 after the additive ``tenancy`` kind). Kept
+#: as a literal so this module stays jax-free; ``tests/test_fleet.py``
+#: pins it to the real SCHEMA_VERSION so the two can never drift
+#: silently.
+FLEET_SCHEMA_VERSION = 14
+
+#: The run classes the arbiter understands (``RunSpec.kind``).
+RUN_KINDS = ("train", "serve")
 
 #: Heartbeat older than this reads as a dead/wedged run (matches the
 #: ``obs tail`` STALE threshold and the builtin heartbeat_stale rule).
@@ -75,11 +105,15 @@ STALE_AFTER_S = 60.0
 class RunSpec:
     """One gang-scheduled run: its name, the size it was submitted at
     (``original`` — also its ceiling: the arbiter never grows a run past
-    what it asked for), and its floor."""
+    what it asked for), its floor, and its class. ``kind`` selects the
+    policy half that governs it: ``train`` runs trade chips on goodput
+    (stall fractions), ``serve`` runs on SLO state (breach/release
+    streaks)."""
 
     name: str
     original: int
     min_procs: int = 1
+    kind: str = "train"
 
     def __post_init__(self):
         if self.original <= 0:
@@ -88,6 +122,10 @@ class RunSpec:
             raise ValueError(
                 f"{self.name}: min_procs {self.min_procs} outside "
                 f"[1, {self.original}]"
+            )
+        if self.kind not in RUN_KINDS:
+            raise ValueError(
+                f"{self.name}: kind {self.kind!r} not in {RUN_KINDS}"
             )
 
 
@@ -106,6 +144,11 @@ class RunSignals:
     heartbeat_age_s: Optional[float] = None
     alive: Optional[bool] = None  # None = no liveness source configured
     epoch: Optional[float] = None
+    # the serving sensor triplet (serve/slo.py scalars — published by
+    # ServingEngine.record_window): demand, health, and the p99 bound
+    queue_depth: Optional[float] = None
+    availability: Optional[float] = None
+    latency_p99_ms: Optional[float] = None
 
     def to_record(self) -> dict:
         out = {
@@ -143,9 +186,15 @@ def read_signals(
             alive = False  # absent beat on a run we were told beats
         else:
             ts = rec.get("ts")
-            if isinstance(ts, (int, float)):
+            if isinstance(ts, (int, float)) and not isinstance(ts, bool):
                 age = (time.time() if now is None else now) - float(ts)
                 alive = age <= STALE_AFTER_S
+            else:
+                # a beat that parsed but carries no usable timestamp
+                # (garbage payload) is as dead as a stale one — leaving
+                # it ``alive=None`` would keep the run grant-eligible
+                # on evidence that says nothing about liveness
+                alive = False
     return RunSignals(
         run=run,
         data_stall_frac=gauge("train.data_stall_frac"),
@@ -155,6 +204,9 @@ def read_signals(
         heartbeat_age_s=round(age, 1) if age is not None else None,
         alive=alive,
         epoch=gauge("train.epoch"),
+        queue_depth=gauge("serve.queue_depth"),
+        availability=gauge("serve.availability"),
+        latency_p99_ms=gauge("serve.latency_p99_ms"),
     )
 
 
@@ -166,6 +218,26 @@ class FleetPolicy:
     receive_stall_frac: float = 0.10  # a recipient must be under this
     hysteresis: float = 0.05          # extra margin to reverse a move
     move_cooldown: int = 2            # ticks a moved run sits out
+    # -- the serve half of the asymmetric policy ----------------------------
+    # a serving SLO breach must be SUSTAINED this many consecutive
+    # readings before it preempts training chips (one noisy window must
+    # not SIGTERM a trainer) — the documented preemption-latency bound
+    # is serve_breach_ticks ticks to the donor's SIGTERM (its probe
+    # fires within one interval of the allocation-file shrink) plus two
+    # ticks (pending maturation + grant) to the chips landing
+    serve_breach_ticks: int = 2
+    # ...and must stay CLEAR this many readings before the serve run
+    # releases its surplus back to training (the off-peak reclaim) —
+    # the serve-side hysteresis against diurnal-edge thrash
+    serve_release_ticks: int = 3
+    # queue-depth growth of at least this much across consecutive
+    # readings counts as a breach signal even before an slo_* alert
+    # fires (the queue explodes faster than a p99 histogram converges)
+    serve_queue_growth: float = 1.0
+    # a serve run is "healthy" (release-streak eligible) only while its
+    # queue is at most this deep and availability is at least this high
+    serve_idle_queue: float = 1.0
+    serve_ok_availability: float = 0.99
 
     def __post_init__(self):
         if not 0.0 <= self.receive_stall_frac < self.donate_stall_frac <= 1.0:
@@ -175,6 +247,19 @@ class FleetPolicy:
             )
         if self.hysteresis < 0 or self.move_cooldown < 0:
             raise ValueError("hysteresis and move_cooldown must be >= 0")
+        if self.serve_breach_ticks < 1 or self.serve_release_ticks < 1:
+            raise ValueError(
+                "serve_breach_ticks and serve_release_ticks must be >= 1"
+            )
+        if (
+            self.serve_queue_growth <= 0
+            or self.serve_idle_queue < 0
+            or not 0.0 <= self.serve_ok_availability <= 1.0
+        ):
+            raise ValueError(
+                "need serve_queue_growth > 0, serve_idle_queue >= 0, "
+                "serve_ok_availability in [0, 1]"
+            )
 
 
 class FleetScheduler:
@@ -234,6 +319,13 @@ class FleetScheduler:
         self._last_move_tick: Dict[str, int] = {}
         self._last_move_dir: Dict[str, str] = {}  # 'donated' | 'received'
         self.decisions = 0
+        self.preemptions = 0
+        # the serve-policy streak state — derived DETERMINISTICALLY from
+        # the signal sequence by note_signals (step drives it), so a
+        # replay of the recorded inputs reproduces every decision
+        self._breach_streak: Dict[str, int] = {}
+        self._healthy_streak: Dict[str, int] = {}
+        self._last_queue_depth: Dict[str, float] = {}
         if fleet_dir:
             os.makedirs(fleet_dir, exist_ok=True)
             for name, a in self.alloc.items():
@@ -258,8 +350,110 @@ class FleetScheduler:
         last = self._last_move_tick.get(run)
         return last is not None and tick - last <= self.policy.move_cooldown
 
+    # -- the serve half: breach/release streaks ------------------------------
+
+    def _serve_breached(self, run: str, sig: RunSignals) -> bool:
+        """One reading's breach verdict: any active ``slo_*`` alert
+        (serve/slo.py SLO_BUILTINS — p99/p50/TTFB/availability/rps/
+        queue), or the queue growing across consecutive readings (the
+        early-warning signal — a queue explodes faster than a p99
+        histogram converges)."""
+        if any(a.startswith("slo_") for a in sig.active_alerts):
+            return True
+        q, last = sig.queue_depth, self._last_queue_depth.get(run)
+        return (
+            q is not None and last is not None
+            and q - last >= self.policy.serve_queue_growth
+        )
+
+    def _serve_healthy(self, run: str, sig: RunSignals) -> bool:
+        """One reading's release-eligibility verdict: no breach signal,
+        queue at idle depth, availability over the bar (an absent
+        availability — no completed requests yet in the window — reads
+        as healthy only alongside an idle queue)."""
+        if self._serve_breached(run, sig):
+            return False
+        if sig.queue_depth is None or sig.queue_depth > self.policy.serve_idle_queue:
+            return False
+        return (
+            sig.availability is None
+            or sig.availability >= self.policy.serve_ok_availability
+        )
+
+    def note_signals(self, signals: Dict[str, RunSignals]) -> None:
+        """Advance each serve run's breach/release streaks from one
+        reading. :meth:`step` calls this before :meth:`decide`; drive it
+        yourself (in signal order) when replaying recorded inputs
+        through :meth:`decide` directly. A run with no reading holds
+        its streaks — absent evidence neither escalates nor clears."""
+        for run, spec in self.specs.items():
+            if spec.kind != "serve":
+                continue
+            sig = signals.get(run)
+            if sig is None:
+                continue
+            if self._serve_breached(run, sig):
+                self._breach_streak[run] = self._breach_streak.get(run, 0) + 1
+                self._healthy_streak[run] = 0
+            elif self._serve_healthy(run, sig):
+                self._healthy_streak[run] = (
+                    self._healthy_streak.get(run, 0) + 1
+                )
+                self._breach_streak[run] = 0
+            else:
+                # neither breached nor idle-healthy (e.g. busy but
+                # within SLO): both streaks reset — no escalation, no
+                # release
+                self._breach_streak[run] = 0
+                self._healthy_streak[run] = 0
+            if sig.queue_depth is not None:
+                self._last_queue_depth[run] = sig.queue_depth
+
+    def _serve_wants_chips(self, run: str, sig: Optional[RunSignals],
+                           tick: int) -> bool:
+        """A serve run whose breach streak crossed the sustained bar and
+        that can still grow. Deliberately NOT cooldown-gated: the
+        breach streak is itself the thrash guard, and the preemption-
+        latency contract cannot hide a cooldown inside it."""
+        spec = self.specs[run]
+        if spec.kind != "serve" or self.alloc[run] >= spec.original:
+            return False
+        if sig is None or sig.alive is False:
+            return False
+        if any(not a.startswith("slo_") for a in sig.active_alerts):
+            # asymmetric alert veto: slo_* alerts ARE the demand signal,
+            # but a non-SLO alert (serve_retrace, heartbeat_stale...)
+            # means the replica is sick — chips won't fix that
+            return False
+        return (
+            self._breach_streak.get(run, 0) >= self.policy.serve_breach_ticks
+        )
+
+    def _serve_can_release(self, run: str, sig: Optional[RunSignals],
+                           tick: int) -> bool:
+        """A serve run healthy long enough to hand its surplus back."""
+        spec = self.specs[run]
+        if spec.kind != "serve" or self.alloc[run] <= spec.min_procs:
+            return False
+        if shrink_target(
+            spec.original, self.alloc[run], self.alloc[run] - 1, spec.min_procs
+        ) is None:
+            return False
+        if self._in_cooldown(run, tick):
+            return False
+        if sig is None or sig.alive is False:
+            return False
+        return (
+            self._healthy_streak.get(run, 0) >= self.policy.serve_release_ticks
+        )
+
+    # -- the train half: stall-fraction thresholds ---------------------------
+
     def _donor_ok(self, run: str, sig: Optional[RunSignals], tick: int) -> bool:
         spec = self.specs[run]
+        if spec.kind == "serve":
+            # a serve run donates on its release streak, not on stall
+            return self._serve_can_release(run, sig, tick)
         if self.alloc[run] <= spec.min_procs:
             return False
         if shrink_target(
@@ -281,6 +475,8 @@ class FleetScheduler:
 
     def _recipient_ok(self, run: str, sig: Optional[RunSignals], tick: int) -> bool:
         spec = self.specs[run]
+        if spec.kind == "serve":
+            return False  # serve runs grow only through the breach path
         if self.alloc[run] >= spec.original:
             return False
         if self._in_cooldown(run, tick):
@@ -296,6 +492,49 @@ class FleetScheduler:
         if self._last_move_dir.get(run) == "donated":
             threshold -= self.policy.hysteresis
         return stall <= threshold
+
+    def _preempt_donor(self, recipient: str, signals: Dict[str, RunSignals],
+                       tick: int) -> Optional[Tuple[str, int]]:
+        """Pick the training run to shrink for a breached serve run:
+        prefer the most data-stalled (its chips buy the least), but —
+        unlike the goodput path — a compute-bound trainer is preempted
+        too when it is all there is: the SLO outranks goodput. Floors,
+        shrink feasibility and liveness still hold; the donor cooldown
+        does NOT (it would add unbounded ticks to the preemption-latency
+        contract). Returns ``(donor, target_size)`` or None."""
+        rspec = self.specs[recipient]
+        rcur = self.alloc[recipient]
+        candidates = sorted(
+            (r for r, s in self.specs.items() if s.kind == "train"),
+            key=lambda r: (
+                -(signals[r].data_stall_frac or 0.0)
+                if r in signals and signals[r] is not None else 0.0,
+                r,
+            ),
+        )
+        for donor in candidates:
+            sig = signals.get(donor)
+            if sig is None or sig.alive is False:
+                continue
+            dspec = self.specs[donor]
+            dcur = self.alloc[donor]
+            # smallest sufficient shrink: walk the donor's feasible
+            # sizes largest-first and take the first whose freed chips
+            # make the serve grow reachable — a preemption must actually
+            # buy the replica set its next bucket, not just wound the
+            # trainer
+            for dtarget in sorted(
+                (s for s in feasible_sizes(dspec.original)
+                 if dspec.min_procs <= s < dcur),
+                reverse=True,
+            ):
+                if grow_target(
+                    rspec.original, rcur,
+                    rcur + self.free + self.pending + (dcur - dtarget),
+                    rspec.original,
+                ) is not None:
+                    return donor, dtarget
+        return None
 
     def mature_pending(self, tick: int) -> None:
         """Fold chips a donor freed at an EARLIER tick into the grantable
@@ -321,7 +560,39 @@ class FleetScheduler:
         as pending until the next tick — never both at once, so the
         allocations on disk never sum past the chips that are actually
         vacant (the donor needs its checkpoint/relaunch window to vacate
-        them)."""
+        them).
+
+        Serve-breach arbitration runs FIRST: a serve run whose breach
+        streak crossed ``serve_breach_ticks`` is granted from the free
+        pool when chips are vacant, else a training donor is preempted
+        (shrunk regardless of stall) — SLO demand outranks every
+        goodput move. Off-peak the release streak turns the serve run
+        into an ordinary donor and the existing recipient-driven
+        donate/grant discipline reclaims the chips for training."""
+        # -- priority 1: a sustained serving SLO breach claims chips ----
+        breached = sorted(
+            (r for r in self.specs
+             if self._serve_wants_chips(r, signals.get(r), tick)),
+            key=lambda r: (-self._breach_streak.get(r, 0), r),
+        )
+        for run in breached:
+            spec = self.specs[run]
+            cur = self.alloc[run]
+            target = grow_target(
+                spec.original, cur, cur + self.free, spec.original
+            )
+            if target is not None:
+                return [self._grant_decision(
+                    tick, signals, run, target, preempt=True
+                )]
+            picked = self._preempt_donor(run, signals, tick)
+            if picked is not None:
+                donor, dtarget = picked
+                return [self._donate_decision(
+                    tick, signals, donor, dtarget, for_run=run, preempt=True
+                )]
+        # -- priority 2: the goodput market (train↔train, plus serve
+        # runs releasing surplus off-peak via _donor_ok) ----------------
         donors = sorted(
             (r for r in self.specs if self._donor_ok(r, signals.get(r), tick)),
             key=lambda r: (-(signals[r].data_stall_frac or 0.0), r),
@@ -348,24 +619,35 @@ class FleetScheduler:
             for donor in donors:
                 dspec = self.specs[donor]
                 dcur = self.alloc[donor]
-                dtarget = shrink_target(
-                    dspec.original, dcur, dcur - 1, dspec.min_procs
-                )
-                if dtarget is None:
-                    continue
-                freed = dcur - dtarget
-                if grow_target(
-                    spec.original, cur,
-                    cur + self.free + self.pending + freed, spec.original,
-                ) is None:
-                    continue  # the donation would never reach a feasible grow
-                return [self._donate_decision(
-                    tick, signals, donor, dtarget, for_run=recipient
-                )]
+                if dspec.kind == "serve":
+                    # an off-peak release may need more than one
+                    # feasible step at once (the trainer's next size up
+                    # can be far away) — take the smallest sufficient
+                    # shrink, largest target first
+                    targets = sorted(
+                        (s for s in feasible_sizes(dspec.original)
+                         if dspec.min_procs <= s < dcur),
+                        reverse=True,
+                    )
+                else:
+                    one = shrink_target(
+                        dspec.original, dcur, dcur - 1, dspec.min_procs
+                    )
+                    targets = [one] if one is not None else []
+                for dtarget in targets:
+                    freed = dcur - dtarget
+                    if grow_target(
+                        spec.original, cur,
+                        cur + self.free + self.pending + freed, spec.original,
+                    ) is None:
+                        continue  # would never reach a feasible grow
+                    return [self._donate_decision(
+                        tick, signals, donor, dtarget, for_run=recipient
+                    )]
         return []
 
     def _base_record(self, tick: int, signals: Dict[str, RunSignals]) -> dict:
-        return {
+        rec = {
             "kind": "fleet",
             "schema_version": FLEET_SCHEMA_VERSION,
             "tick": int(tick),
@@ -374,39 +656,61 @@ class FleetScheduler:
             },
             "policy": dataclasses.asdict(self.policy),
         }
+        streaks = {
+            r: {
+                "breach": self._breach_streak.get(r, 0),
+                "healthy": self._healthy_streak.get(r, 0),
+            }
+            for r, s in sorted(self.specs.items()) if s.kind == "serve"
+        }
+        if streaks:
+            rec["serve_streaks"] = streaks
+        return rec
 
     def _grant_decision(
         self, tick: int, signals: Dict[str, RunSignals],
-        recipient: str, recipient_to: int,
+        recipient: str, recipient_to: int, preempt: bool = False,
     ) -> dict:
         before = dict(self.alloc)
         after = dict(before)
         after[recipient] = recipient_to
         moved = recipient_to - before[recipient]
         rsig = signals.get(recipient)
+        if preempt:
+            reason = (
+                f"sustained SLO breach "
+                f"({self._breach_streak.get(recipient, 0)} reading(s)) — "
+                f"free pool staffs breached serve run {recipient}"
+                + (
+                    f" (queue {rsig.queue_depth:g})"
+                    if rsig is not None and rsig.queue_depth is not None
+                    else ""
+                )
+            )
+        else:
+            reason = "free pool staffs compute-bound " + recipient + (
+                f" (stall {rsig.data_stall_frac:.0%})"
+                if rsig is not None and rsig.data_stall_frac is not None
+                else ""
+            )
         return {
             **self._base_record(tick, signals),
             "action": "grant",
             "donor": None,
             "recipient": recipient,
             "chips": int(moved),
+            "preempt": bool(preempt),
             "alloc_before": before,
             "alloc_after": after,
             "free_before": self.free,
             "free_after": self.free - moved,
             "pending_after": self.pending,
-            "reason": "free pool staffs compute-bound "
-            + recipient
-            + (
-                f" (stall {rsig.data_stall_frac:.0%})"
-                if rsig is not None and rsig.data_stall_frac is not None
-                else ""
-            ),
+            "reason": reason,
         }
 
     def _donate_decision(
         self, tick: int, signals: Dict[str, RunSignals],
-        donor: str, donor_to: int, for_run: str,
+        donor: str, donor_to: int, for_run: str, preempt: bool = False,
     ) -> dict:
         before = dict(self.alloc)
         after = dict(before)
@@ -414,19 +718,27 @@ class FleetScheduler:
         freed = before[donor] - after[donor]
         dsig = signals.get(donor)
         fsig = signals.get(for_run)
-        return {
-            **self._base_record(tick, signals),
-            "action": "donate",
-            "donor": donor,
-            "recipient": None,
-            "for_run": for_run,
-            "chips": int(freed),
-            "alloc_before": before,
-            "alloc_after": after,
-            "free_before": self.free,
-            "free_after": self.free,
-            "pending_after": self.pending + freed,
-            "reason": (
+        if preempt:
+            reason = (
+                f"sustained SLO breach on {for_run} "
+                f"({self._breach_streak.get(for_run, 0)} reading(s)) "
+                f"preempts {freed} chip(s) from trainer {donor} "
+                "(SIGTERM→emergency-save→exit-75) — grantable next tick"
+            )
+        elif self.specs[donor].kind == "serve":
+            reason = (
+                f"serve run {donor} healthy "
+                f"{self._healthy_streak.get(donor, 0)} reading(s) releases "
+                f"{freed} chip(s) toward compute-bound {for_run}"
+                + (
+                    f" (stall {fsig.data_stall_frac:.0%})"
+                    if fsig is not None and fsig.data_stall_frac is not None
+                    else ""
+                )
+                + " — grantable next tick"
+            )
+        else:
+            reason = (
                 f"{donor} "
                 + (
                     f"{dsig.data_stall_frac:.0%} "
@@ -441,7 +753,21 @@ class FleetScheduler:
                     else ""
                 )
                 + " — grantable next tick"
-            ),
+            )
+        return {
+            **self._base_record(tick, signals),
+            "action": "donate",
+            "donor": donor,
+            "recipient": None,
+            "for_run": for_run,
+            "chips": int(freed),
+            "preempt": bool(preempt),
+            "alloc_before": before,
+            "alloc_after": after,
+            "free_before": self.free,
+            "free_after": self.free,
+            "pending_after": self.pending + freed,
+            "reason": reason,
         }
 
     # -- actuation + audit ---------------------------------------------------
@@ -467,7 +793,28 @@ class FleetScheduler:
             self._pending_since = tick
         self.decisions += 1
         counters_lib.inc("fleet.decisions")
+        if decision.get("preempt"):
+            self.preemptions += 1
+            counters_lib.inc("fleet.preemptions")
         self._publish_gauges()
+
+    def tenancy_record(self, tick: int) -> dict:
+        """One per-tick chip-accounting snapshot (``tenancy`` history
+        kind, schema v14): every run's allocation plus the free and
+        pending pools. ``sum(alloc) + free + pending == total_chips``
+        holds at every tick (the pools are conserved by construction),
+        which is what makes :func:`audit_chip_seconds` exact rather
+        than approximate."""
+        return {
+            "kind": "tenancy",
+            "schema_version": FLEET_SCHEMA_VERSION,
+            "tick": int(tick),
+            "alloc": dict(self.alloc),
+            "free": int(self.free),
+            "pending": int(self.pending),
+            "total_chips": int(self.total_chips),
+            "run_kinds": {r: s.kind for r, s in sorted(self.specs.items())},
+        }
 
     def step(
         self,
@@ -475,18 +822,26 @@ class FleetScheduler:
         signals: Dict[str, RunSignals],
         ts: Optional[float] = None,
     ) -> List[dict]:
-        """mature pending → decide → apply → audit. ``ts`` annotates the
-        record for humans and cross-run joins; the POLICY never reads it
-        (reproducibility contract)."""
+        """mature pending → note serve streaks → decide → apply → audit
+        (every decision PLUS one per-tick ``tenancy`` snapshot). ``ts``
+        annotates the records for humans and cross-run joins; the
+        POLICY never reads it (reproducibility contract)."""
         self.mature_pending(tick)
+        self.note_signals(signals)
         decisions = self.decide(tick, signals)
+        now = time.time() if ts is None else ts
         for d in decisions:
             self.apply(d, tick)
             if self.fleet_dir:
                 rec = dict(d)
-                rec["ts"] = time.time() if ts is None else ts
+                rec["ts"] = now
                 with open(self.history_path(), "a") as f:
                     f.write(json.dumps(rec) + "\n")
+        if self.fleet_dir:
+            rec = self.tenancy_record(tick)
+            rec["ts"] = now
+            with open(self.history_path(), "a") as f:
+                f.write(json.dumps(rec) + "\n")
         return decisions
 
     def _publish_gauges(self) -> None:
@@ -502,6 +857,7 @@ class FleetScheduler:
         return export_lib.render(
             {
                 "fleet.decisions": self.decisions,
+                "fleet.preemptions": self.preemptions,
                 "fleet.free_chips": self.free,
                 "fleet.pending_chips": self.pending,
             },
@@ -515,3 +871,68 @@ class FleetScheduler:
         with open(tmp, "w") as f:
             f.write(self.exposition())
         os.replace(tmp, path)
+
+
+# -- chip-second accounting ---------------------------------------------------
+
+
+def audit_chip_seconds(
+    records: List[dict], tick_s: float = 1.0
+) -> dict:
+    """The conservation audit over a run's ``tenancy`` snapshots: the
+    per-run chip-second buckets ∪ the scheduler's own free/pending
+    account must equal the pod's chip-seconds **exactly** — integer
+    chip-ticks scaled by ``tick_s``, no float accumulation in the
+    identity itself.
+
+    ``records`` is any iterable of history records (non-``tenancy``
+    kinds are ignored — pass a whole parsed ``fleet.jsonl``). Returns::
+
+        {"n_ticks", "total_chips", "tick_s",
+         "per_run": {run: chip_seconds}, "free_chip_s", "pending_chip_s",
+         "accounted_chip_s", "pod_chip_s", "conserved", "violations"}
+
+    ``conserved`` is the exact identity over the whole window;
+    ``violations`` lists any single tick where
+    ``sum(alloc) + free + pending != total_chips`` (none can occur for
+    snapshots a :class:`FleetScheduler` wrote — the pools are conserved
+    by construction — so a violation means the log was edited or mixed
+    from two schedulers)."""
+    snaps = [r for r in records if r.get("kind") == "tenancy"]
+    per_run_ticks: Dict[str, int] = {}
+    free_ticks = 0
+    pending_ticks = 0
+    total_chips = 0
+    violations: List[dict] = []
+    for r in snaps:
+        alloc = r.get("alloc") or {}
+        free = int(r.get("free") or 0)
+        pending = int(r.get("pending") or 0)
+        total_chips = int(r.get("total_chips") or 0)
+        for run, a in alloc.items():
+            per_run_ticks[run] = per_run_ticks.get(run, 0) + int(a)
+        free_ticks += free
+        pending_ticks += pending
+        if sum(int(a) for a in alloc.values()) + free + pending != total_chips:
+            violations.append({
+                "tick": r.get("tick"), "alloc": dict(alloc),
+                "free": free, "pending": pending,
+                "total_chips": total_chips,
+            })
+    n_ticks = len(snaps)
+    accounted_ticks = sum(per_run_ticks.values()) + free_ticks + pending_ticks
+    pod_ticks = total_chips * n_ticks
+    return {
+        "n_ticks": n_ticks,
+        "total_chips": total_chips,
+        "tick_s": tick_s,
+        "per_run": {
+            run: t * tick_s for run, t in sorted(per_run_ticks.items())
+        },
+        "free_chip_s": free_ticks * tick_s,
+        "pending_chip_s": pending_ticks * tick_s,
+        "accounted_chip_s": accounted_ticks * tick_s,
+        "pod_chip_s": pod_ticks * tick_s,
+        "conserved": accounted_ticks == pod_ticks and not violations,
+        "violations": violations,
+    }

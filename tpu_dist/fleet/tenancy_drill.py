@@ -1,0 +1,728 @@
+"""The tenancy drill — ``make tenancy-drill`` /
+``python -m tpu_dist.fleet.tenancy_drill``.
+
+The end-to-end proof of SLO-aware train+serve co-scheduling
+(docs/resilience.md "Multi-tenant pod"), self-contained on CPU. One
+recorded diurnal day — off-peak → load spike → recovery → off-peak —
+is replayed through the kind-aware :class:`~tpu_dist.fleet.scheduler.
+FleetScheduler` in three phases:
+
+**Phase policy** (fast, tier-1) — the deterministic replay on a manual
+tick clock: every tick writes the RECORDED serve exposition (real
+``ServeStats`` windows through the real SLO alert engine — the spike
+windows genuinely fire ``slo_*`` rules), genuinely scrapes it back
+through ``read_signals``, and steps the scheduler. Asserted exactly:
+the preempt-donate fires at ``spike_tick + serve_breach_ticks - 1``,
+the chips land one tick later (the documented preemption-latency
+bound), availability recovers over threshold, the off-peak release +
+grow-back land at their tick-arithmetic positions, and the chip-second
+conservation identity holds **exactly** (``audit_chip_seconds`` over
+the per-tick ``tenancy`` snapshots: per-run bucket sums ∪ free ∪
+pending == pod chip-seconds, integer chip-ticks, no float slack).
+
+**Phase cycle** (slow) — the same day against a REAL trainer: a golden
+uninterrupted run first, then the co-scheduled run driven by the real
+``elastic/supervisor.py`` loop + capacity probe over the scheduler's
+allocation file. The spike preempts the trainer (allocation shrinks →
+probe → SIGTERM → emergency save → exit 75 → relaunch smaller), the
+serve run is granted the chips, the recovery windows turn healthy, and
+off-peak the two-phase donate/grant reclaims the chips (allocation
+grows → probe → checkpoint → relaunch at full size). Verified: a
+shrink AND a grow resume record, every epoch's loss within the golden
+trajectory tolerance, the scraped availability back over threshold,
+the wall-clock SIGTERM latency, and the exact conservation identity.
+
+**Phase replica** (slow) — the serving half of robustness: a real
+supervised replica process is SIGKILL'd mid-serve; the
+:class:`~tpu_dist.serve.supervisor.ReplicaSupervisor` detects the
+crash, postmortem-bundles the evidence dirs BEFORE relaunching, and
+the relaunch restores through the CRC-verified ladder — proven
+bit-exact (equal weights digests across incarnations) with zero
+post-warmup retraces, then drains gracefully on SIGTERM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional, Sequence
+
+from tpu_dist.fleet.drill import LOSS_RTOL, _epoch_losses, _load, _train_env
+from tpu_dist.fleet.scheduler import (
+    FleetPolicy,
+    FleetScheduler,
+    RunSpec,
+    audit_chip_seconds,
+    read_signals,
+)
+from tpu_dist.obs import export as export_lib
+
+#: The recorded diurnal day the policy phase replays, one profile per
+#: scheduler tick. With the default policy (serve_breach_ticks=2,
+#: serve_release_ticks=3, move_cooldown=2) the arbitration events MUST
+#: land at: preempt-donate @3, preempt-grant @4, off-peak release @8,
+#: trainer grow-back grant @9.
+DIURNAL_TRACE = (
+    "idle",        # 1: off-peak — trainer soaks the pod
+    "spike",       # 2: the load spike arrives (queue growth + slo_* fire)
+    "spike",       # 3: sustained -> breach streak == serve_breach_ticks
+    "spike",       # 4: pending matures -> the chips land
+    "recovering",  # 5: latency back under SLO, backlog still draining
+    "idle",        # 6: healthy reading 1
+    "idle",        # 7: healthy reading 2
+    "idle",        # 8: healthy reading 3 -> off-peak release
+    "idle",        # 9: released chips mature -> trainer grows back
+    "idle",        # 10: steady state again
+)
+SPIKE_TICK = 1 + DIURNAL_TRACE.index("spike")  # ticks are 1-based
+
+#: One drill tick in seconds — the manual clock the policy phase stamps
+#: records with, and the chip-second unit of the conservation report.
+TICK_SECONDS = 1.0
+
+
+def _say(msg: str) -> None:
+    # tpu-dist: ignore[TD002,TD007] — single-process CLI; stdout is the report
+    print(f"tenancy-drill: {msg}", flush=True)
+
+
+def _pod_scheduler(fleet_dir: Optional[str], devices: int, shrink_to: int):
+    """The drill pod: one trainer soaking most of the chips, one serve
+    run at its off-peak size, one chip vacant — 11 chips total at the
+    defaults. Both phases use the SAME shape so the policy phase's tick
+    arithmetic transfers to the real-trainer cycle."""
+    return FleetScheduler(
+        [
+            RunSpec("trainer", devices, min_procs=shrink_to, kind="train"),
+            RunSpec("svc", shrink_to, min_procs=1, kind="serve"),
+        ],
+        policy=FleetPolicy(),
+        fleet_dir=fleet_dir,
+        allocations={"trainer": devices, "svc": shrink_to // 2},
+        total_chips=devices + shrink_to // 2 + 1,
+    )
+
+
+# -- the recorded serve windows ----------------------------------------------
+
+
+def _serve_window_stats(profile: str, k: int = 0):
+    """One recorded serving window. ``spike`` blows the 500 ms p99
+    ceiling and the 50 ms deadline with a queue exploding tick over
+    tick (``k`` = spike tick index); ``recovering`` is back under every
+    ceiling but still draining backlog (not release-eligible);
+    ``idle`` is the off-peak window."""
+    from tpu_dist.serve import slo as slo_lib
+
+    stats = slo_lib.ServeStats(deadline_s=0.05)
+    if profile == "spike":
+        for _ in range(4):
+            stats.on_batch(3, 4)
+            stats.on_request_done(
+                0.6, 0.45, {p: 0.1 for p in slo_lib.PHASES}
+            )
+        stats.set_queue_depth(4 + 3 * k)
+    elif profile == "recovering":
+        for _ in range(4):
+            stats.on_batch(4, 4)
+            stats.on_request_done(
+                0.02, 0.01, {p: 0.004 for p in slo_lib.PHASES}
+            )
+        stats.set_queue_depth(2)
+    else:  # idle
+        for _ in range(2):
+            stats.on_batch(1, 1)
+            stats.on_request_done(
+                0.02, 0.01, {p: 0.004 for p in slo_lib.PHASES}
+            )
+        stats.set_queue_depth(0)
+    return stats
+
+
+def _write_serve_exposition(path: str, engine, profile: str, k: int) -> dict:
+    """Render one recorded window through the PERSISTENT SLO alert
+    engine (exactly what a replica's exporter publishes: the ``slo_*``
+    rules fire on the spike windows and clear on the clean ones) and
+    write it atomically. Returns the window scalars."""
+    stats = _serve_window_stats(profile, k)
+    window = stats.scalars(window_s=1.0, completed_in_window=stats.completed)
+    engine.observe(window)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(export_lib.render(
+            window,
+            {"alert_active": engine.active()},
+            histograms=stats.histogram_families(),
+        ))
+    os.replace(tmp, path)
+    return window
+
+
+def _write_trainer_exposition(path: str, stall: float = 0.02) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(export_lib.render({
+            "train.data_stall_frac": stall,
+            "goodput.goodput_frac": 0.93,
+            "train.mfu": 0.52,
+            "train.epoch": 1,
+        }))
+    os.replace(tmp, path)
+
+
+def _report_conservation(records: List[dict]) -> bool:
+    audit = audit_chip_seconds(records, tick_s=TICK_SECONDS)
+    per_run = ", ".join(
+        f"{run}={cs:g}" for run, cs in audit["per_run"].items()
+    )
+    _say(
+        f"chip-seconds over {audit['n_ticks']} tick(s) x "
+        f"{audit['total_chips']} chip(s): {per_run}, "
+        f"free={audit['free_chip_s']:g}, pending={audit['pending_chip_s']:g} "
+        f"-> accounted {audit['accounted_chip_s']:g} of "
+        f"{audit['pod_chip_s']:g} pod chip-seconds"
+    )
+    if not audit["conserved"]:
+        _say(f"FAIL: chip-second conservation VIOLATED: "
+             f"{audit['violations'] or 'totals diverge'}")
+        return False
+    _say("chip-second conservation identity holds EXACTLY")
+    return True
+
+
+# -- phase policy ------------------------------------------------------------
+
+
+def run_policy_phase(args) -> int:
+    """The recorded diurnal replay on the manual tick clock — pure host
+    arithmetic (no jax, no subprocesses), every signal genuinely
+    scraped off disk."""
+    from tpu_dist.serve import slo as slo_lib
+
+    fleet_dir = os.path.join(args.workdir, "policy_fleet")
+    sched = _pod_scheduler(fleet_dir, args.devices, args.shrink_to)
+    policy = sched.policy
+    slo_engine = slo_lib.make_slo_engine(slo_lib.load_slo_rules("default"))
+    svc_prom = os.path.join(fleet_dir, "svc", "metrics.prom")
+    trainer_prom = os.path.join(fleet_dir, "trainer", "metrics.prom")
+    os.makedirs(os.path.dirname(svc_prom), exist_ok=True)
+    _write_trainer_exposition(trainer_prom)
+
+    by_tick: dict = {}
+    spike_k = 0
+    recovered_at: Optional[int] = None
+    for tick, profile in enumerate(DIURNAL_TRACE, start=1):
+        window = _write_serve_exposition(
+            svc_prom, slo_engine, profile, spike_k
+        )
+        if profile == "spike":
+            spike_k += 1
+        sig = {
+            "trainer": read_signals("trainer", trainer_prom),
+            "svc": read_signals("svc", svc_prom),
+        }
+        if sig["svc"].queue_depth != window["serve.queue_depth"]:
+            _say(f"FAIL: tick {tick}: scrape did not round-trip the queue")
+            return 1
+        for d in sched.step(tick, sig, ts=tick * TICK_SECONDS):
+            by_tick[tick] = d
+            _say(f"tick {tick}: {d['action']}"
+                 f"{' [SLO preemption]' if d.get('preempt') else ''} — "
+                 f"{d['reason']}")
+        if (
+            recovered_at is None
+            and tick > SPIKE_TICK
+            and (sig["svc"].availability or 0.0)
+            >= policy.serve_ok_availability
+        ):
+            recovered_at = tick
+            _say(f"tick {tick}: availability "
+                 f"{sig['svc'].availability:.1%} — recovered over "
+                 f"{policy.serve_ok_availability:.1%}")
+
+    donate_tick = SPIKE_TICK + policy.serve_breach_ticks - 1
+    grant_tick = donate_tick + 1
+    checks = (
+        ("preempt-donate at the documented bound",
+         by_tick.get(donate_tick, {}).get("action") == "donate"
+         and by_tick[donate_tick].get("preempt") is True
+         and by_tick[donate_tick].get("donor") == "trainer"),
+        ("preempt-grant one tick later",
+         by_tick.get(grant_tick, {}).get("action") == "grant"
+         and by_tick[grant_tick].get("preempt") is True
+         and by_tick[grant_tick].get("recipient") == "svc"),
+        ("availability recovered after the chips landed",
+         recovered_at is not None and recovered_at > grant_tick),
+        ("off-peak release fired",
+         any(d.get("action") == "donate" and d.get("donor") == "svc"
+             and not d.get("preempt") for d in by_tick.values())),
+        ("trainer grew back to its original size",
+         sched.alloc["trainer"] == args.devices),
+        ("both preemption moves counted",
+         sched.preemptions == 2),
+    )
+    ok = True
+    for what, passed in checks:
+        if not passed:
+            _say(f"FAIL: {what}")
+            ok = False
+    if not ok:
+        return 1
+    _say(
+        f"preemption latency: SIGTERM'd the trainer at tick {donate_tick} "
+        f"(= spike tick {SPIKE_TICK} + serve_breach_ticks "
+        f"{policy.serve_breach_ticks} - 1), chips landed at tick "
+        f"{grant_tick}"
+    )
+    if not _report_conservation(_load(sched.history_path())):
+        return 1
+    _say("PASS policy: recorded diurnal replay reproduced every "
+         "arbitration event at its documented tick")
+    return 0
+
+
+# -- phase cycle -------------------------------------------------------------
+
+
+class _DiurnalDriver:
+    """The cycle phase's signal source: the same recorded profiles, but
+    paced against the REAL trainer — the spike starts once the trainer
+    has banked an epoch and HOLDS until the serve run has its chips
+    (the breach must stay sustained through the donor's vacate window),
+    the recovery holds until the SHRUNKEN trainer has resumed and
+    banked an epoch of its own, then the day goes idle (the off-peak
+    reclaim window)."""
+
+    def __init__(self, sched: FleetScheduler, elastic_log: str,
+                 shrink_to: int):
+        self.sched = sched
+        self.elastic_log = elastic_log
+        self.shrink_to = shrink_to
+        self.tick = 0
+        self.spike_k = 0
+        self.spike_tick: Optional[int] = None
+        self.donate_tick: Optional[int] = None
+        self.donated_at_s: Optional[float] = None
+        self.grant_tick: Optional[int] = None
+        self.recovered = False
+        self.decisions: List[dict] = []
+        self._log_size = -1
+        self._records: List[dict] = []
+        from tpu_dist.serve import slo as slo_lib
+
+        self.slo_engine = slo_lib.make_slo_engine(
+            slo_lib.load_slo_rules("default")
+        )
+        self.svc_prom = os.path.join(sched.fleet_dir, "svc", "metrics.prom")
+        self.trainer_prom = os.path.join(
+            sched.fleet_dir, "trainer", "metrics.prom"
+        )
+        os.makedirs(os.path.dirname(self.svc_prom), exist_ok=True)
+        _write_trainer_exposition(self.trainer_prom)
+
+    def _log(self) -> List[dict]:
+        try:
+            size = os.path.getsize(self.elastic_log)
+        except OSError:
+            return self._records
+        if size != self._log_size:  # re-parse only on growth
+            self._log_size = size
+            self._records = _load(self.elastic_log)
+        return self._records
+
+    def _any_epoch_banked(self) -> bool:
+        return any(r.get("kind") == "train_epoch" for r in self._log())
+
+    def _shrunken_epoch_banked(self) -> bool:
+        """True once the log shows a shrink resume record FOLLOWED by a
+        completed epoch — the off-peak reclaim must not start before
+        the preempted trainer has proven it resumed and made progress
+        at the smaller size."""
+        recs = self._log()
+        for i, r in enumerate(recs):
+            if r.get("kind") == "resume" and r.get("dp") == self.shrink_to:
+                return any(
+                    x.get("kind") == "train_epoch" for x in recs[i + 1:]
+                )
+        return False
+
+    def profile(self) -> str:
+        if self.grant_tick is None:
+            # pre-grant: off-peak until the trainer banks an epoch,
+            # then the spike holds until the chips land
+            if self.spike_tick is None and not self._any_epoch_banked():
+                return "idle"
+            return "spike"
+        if self.sched.alloc["svc"] == self.sched.specs["svc"].original:
+            # peak allocation held: recover, then idle once the
+            # shrunken trainer banked its epoch
+            return (
+                "idle" if self._shrunken_epoch_banked() else "recovering"
+            )
+        return "idle"  # reclaimed — the day stays off-peak
+
+    def step(self) -> None:
+        self.tick += 1
+        profile = self.profile()
+        if profile == "spike" and self.spike_tick is None:
+            self.spike_tick = self.tick
+            _say(f"tick {self.tick}: the recorded load spike begins")
+        window = _write_serve_exposition(
+            self.svc_prom, self.slo_engine, profile, self.spike_k
+        )
+        if profile == "spike":
+            self.spike_k += 1
+        sig = {
+            "trainer": read_signals("trainer", self.trainer_prom),
+            "svc": read_signals("svc", self.svc_prom),
+        }
+        for d in self.sched.step(self.tick, sig, ts=time.time()):
+            self.decisions.append(d)
+            _say(f"tick {self.tick}: {d['action']}"
+                 f"{' [SLO preemption]' if d.get('preempt') else ''} — "
+                 f"{d['reason']}")
+            if d.get("preempt") and d["action"] == "donate":
+                self.donate_tick = self.tick
+                self.donated_at_s = time.monotonic()
+            if d.get("preempt") and d["action"] == "grant":
+                self.grant_tick = self.tick
+        if (
+            self.grant_tick is not None
+            and not self.recovered
+            and (sig["svc"].availability or 0.0)
+            >= self.sched.policy.serve_ok_availability
+        ):
+            self.recovered = True
+            _say(f"tick {self.tick}: serving availability "
+                 f"{sig['svc'].availability:.1%} — recovered")
+
+
+def run_cycle_phase(args) -> int:
+    from tpu_dist.elastic.supervisor import (
+        CapacityProbe,
+        RoundResult,
+        supervise,
+    )
+    from tpu_dist.fleet import capacity as capacity_lib
+    from tpu_dist.resilience.preemption import PREEMPTION_EXIT_CODE
+
+    golden_log = os.path.join(args.workdir, "golden.jsonl")
+    elastic_log = os.path.join(args.workdir, "elastic.jsonl")
+    base = [
+        "--dataset", "synthetic", "--model", args.model,
+        "--num_classes", "10", "--synthetic_n", "256",
+        "--batch_size", str(args.batch_size),
+        "--epochs", str(args.epochs),
+        "--steps_per_epoch", str(args.steps_per_epoch),
+        "--eval_every", "0", "--save_every", "1", "--log_every", "50",
+        "--seed", "0", "--shard_weight_update",
+    ]
+    _say(f"phase golden: {args.devices} device(s), uninterrupted")
+    rc = subprocess.call(
+        [sys.executable, "-m", "tpu_dist.cli.train"] + base
+        + ["--ckpt_dir", os.path.join(args.workdir, "ck_golden"),
+           "--log_file", golden_log],
+        env=_train_env(args.devices),
+    )
+    if rc != 0:
+        _say(f"FAIL: golden run exited {rc}")
+        return 1
+
+    fleet_dir = os.path.join(args.workdir, "cycle_fleet")
+    sched = _pod_scheduler(fleet_dir, args.devices, args.shrink_to)
+    driver = _DiurnalDriver(sched, elastic_log, shrink_to=args.shrink_to)
+    probe = CapacityProbe(
+        capacity_lib.make_census(sched.allocation_path("trainer")),
+        original=args.devices,
+        min_procs=args.shrink_to,
+        interval=0.3,
+    )
+    elastic_ck = os.path.join(args.workdir, "ck_elastic")
+    preempt_latency = [None]
+
+    def round_fn(n: int, round_idx: int) -> RoundResult:
+        child = [sys.executable, "-m", "tpu_dist.cli.train"] + base + [
+            "--ckpt_dir", elastic_ck, "--log_file", elastic_log,
+        ]
+        if round_idx:
+            child += ["--resume"]
+        env = _train_env(n)
+        env["TPU_DIST_ELASTIC_RESTARTS"] = str(round_idx)
+        _say(f"round {round_idx}: trainer at {n} device(s)")
+        proc = subprocess.Popen(child, env=env)
+        probe.reset_timer()
+        resize: Optional[int] = None
+        last_tick = time.monotonic()
+        while proc.poll() is None:
+            time.sleep(0.1)
+            if time.monotonic() - last_tick >= args.tick_s:
+                last_tick = time.monotonic()
+                driver.step()
+            if resize is None:
+                target = probe.poll(n)
+                if target is not None and target != n:
+                    _say(
+                        f"probe: census wants {target} (running {n}) — "
+                        "checkpointing this round for the resize"
+                    )
+                    resize = target
+                    proc.send_signal(signal.SIGTERM)
+        rc = proc.returncode
+        _say(f"round {round_idx}: exit {rc}")
+        if (
+            rc == PREEMPTION_EXIT_CODE
+            and resize is not None
+            and resize < n
+            and preempt_latency[0] is None
+            and driver.donated_at_s is not None
+        ):
+            preempt_latency[0] = time.monotonic() - driver.donated_at_s
+        return RoundResult(rc, {0: rc}, resize)
+
+    rc = supervise(
+        round_fn,
+        nproc=args.devices,
+        min_procs=args.shrink_to,
+        max_restarts=4,
+        backoff_base=0.01,
+        announce=lambda m: _say(f"supervisor: {m}"),
+        probe=probe,
+    )
+    if rc != 0:
+        _say(f"FAIL: supervised co-scheduled run exited {rc}")
+        return 1
+
+    recs = _load(elastic_log)
+    resumes = [r for r in recs if r.get("kind") == "resume"]
+    shrinks = [
+        r for r in resumes
+        if r.get("prev_dp") == args.devices and r.get("dp") == args.shrink_to
+    ]
+    grows = [
+        r for r in resumes
+        if r.get("prev_dp") == args.shrink_to and r.get("dp") == args.devices
+    ]
+    policy = sched.policy
+    checks = (
+        ("a preempt-shrink resume record", bool(shrinks)),
+        ("an off-peak grow resume record", bool(grows)),
+        ("the preempt-donate decision fired",
+         driver.donate_tick is not None and driver.spike_tick is not None),
+        ("the serve run got its chips one tick later",
+         driver.grant_tick == (driver.donate_tick or 0) + 1),
+        ("SIGTERM within the tick bound",
+         driver.donate_tick is not None
+         and driver.donate_tick - driver.spike_tick + 1
+         == policy.serve_breach_ticks),
+        ("serving availability recovered", driver.recovered),
+        ("trainer back at full size",
+         sched.alloc["trainer"] == args.devices),
+        ("preemption wall latency measured",
+         preempt_latency[0] is not None and preempt_latency[0] < 60.0),
+    )
+    ok = True
+    for what, passed in checks:
+        if not passed:
+            _say(f"FAIL: {what}")
+            ok = False
+    if not ok:
+        return 1
+    _say(
+        f"preemption latency: donate at tick {driver.donate_tick} "
+        f"(spike at {driver.spike_tick}, bound serve_breach_ticks="
+        f"{policy.serve_breach_ticks}); SIGTERM->exit-75 in "
+        f"{preempt_latency[0]:.1f}s of wall clock"
+    )
+    golden = _epoch_losses(_load(golden_log))
+    elastic = _epoch_losses(recs)
+    for epoch, want in sorted(golden.items()):
+        got = elastic.get(epoch)
+        if got is None:
+            _say(f"FAIL: co-scheduled run has no epoch {epoch}")
+            return 1
+        rel = abs(got - want) / max(abs(want), 1e-12)
+        _say(
+            f"epoch {epoch}: golden loss {want:.6f}, co-scheduled "
+            f"{got:.6f} (rel {rel:.2e})"
+        )
+        if rel > LOSS_RTOL:
+            _say(f"FAIL: loss diverged past rtol {LOSS_RTOL}")
+            return 1
+    if not _report_conservation(_load(sched.history_path())):
+        return 1
+    _say(
+        "PASS cycle: spike preempted the trainer losslessly, serving "
+        "recovered, off-peak reclaimed the chips, books balanced"
+    )
+    return 0
+
+
+# -- phase replica -----------------------------------------------------------
+
+_MAKE_CKPT = """
+import sys
+from tpu_dist.serve.drill import _drill_model, write_training_ckpt
+write_training_ckpt(sys.argv[1], _drill_model())
+"""
+
+
+def run_replica_phase(args, timeout_s: float = 180.0) -> int:
+    """SIGKILL a real supervised serving replica and prove the
+    crash→bundle→relaunch→bit-exact-restore loop."""
+    from tpu_dist.serve.supervisor import ReplicaPolicy, ReplicaSupervisor
+
+    rdir = os.path.join(args.workdir, "replica")
+    ckpt_dir = os.path.join(rdir, "ck")
+    os.makedirs(rdir, exist_ok=True)
+    status = os.path.join(rdir, "status.jsonl")
+    rc = subprocess.call(
+        [sys.executable, "-c", _MAKE_CKPT, ckpt_dir], env=_train_env(4)
+    )
+    if rc != 0:
+        _say(f"FAIL: checkpoint writer exited {rc}")
+        return 1
+
+    def spawn(incarnation: int):
+        _say(f"spawning replica incarnation {incarnation}")
+        return subprocess.Popen(
+            [
+                sys.executable, "-m", "tpu_dist.serve", "replica",
+                "--ckpt", ckpt_dir, "--workdir", rdir,
+                "--status_file", status, "--pace_s", "0.02",
+            ],
+            env=_train_env(1),
+        )
+
+    sup = ReplicaSupervisor(
+        spawn,
+        heartbeat_file=os.path.join(rdir, "hb.json"),
+        policy=ReplicaPolicy(max_restarts=2, backoff_base_s=0.01),
+        postmortem_dirs=[rdir],
+    )
+
+    def readys() -> List[dict]:
+        if not os.path.exists(status):
+            return []
+        with open(status) as f:
+            return [
+                json.loads(ln) for ln in f
+                if ln.strip() and json.loads(ln).get("event") == "ready"
+            ]
+
+    def wait(what, cond, deadline) -> bool:
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            time.sleep(0.2)
+        _say(f"FAIL: timed out waiting for {what}")
+        return False
+
+    deadline = time.monotonic() + timeout_s
+    sup.start()
+    try:
+        if not wait("incarnation 1 ready", lambda: len(readys()) >= 1,
+                    deadline):
+            return 1
+        first = readys()[0]
+        _say(f"incarnation 1 ready: digest {first['weights_digest']}, "
+             f"{first['warmup_compiles']} warmup compile(s)")
+
+        _say(f"SIGKILL pid {sup.proc.pid} (the crash under test)")
+        os.kill(sup.proc.pid, signal.SIGKILL)
+        if not wait("the kill to land", lambda: sup.proc.poll() is not None,
+                    deadline):
+            return 1
+        verdict = sup.poll_once()
+        if verdict != "crash":
+            _say(f"FAIL: supervisor verdict {verdict!r}, wanted 'crash'")
+            return 1
+        bundles = [e for e in sup.events if e["event"] == "postmortem"]
+        if not bundles:
+            _say("FAIL: crash was not postmortem-bundled before relaunch")
+            return 1
+        _say(f"crash detected (rc {sup.last_rc}), bundled: "
+             f"{bundles[-1]['bundle']}")
+
+        if not wait("incarnation 2 ready", lambda: len(readys()) >= 2,
+                    deadline):
+            return 1
+        second = readys()[1]
+        if second["weights_digest"] != first["weights_digest"]:
+            _say(f"FAIL: relaunch digest {second['weights_digest']} != "
+                 f"{first['weights_digest']} — restore not bit-exact")
+            return 1
+        _say("relaunch restored BIT-EXACT weights "
+             f"(digest {second['weights_digest']})")
+
+        sup.proc.send_signal(signal.SIGTERM)  # graceful vacate
+        if not wait("the graceful drain", lambda: sup.proc.poll() is not None,
+                    deadline):
+            return 1
+        if sup.poll_once() != "exit" or not sup.done:
+            _say(f"FAIL: expected a clean exit, got rc {sup.last_rc}")
+            return 1
+        with open(status) as f:
+            drained = [
+                json.loads(ln) for ln in f
+                if ln.strip() and json.loads(ln).get("event") == "drained"
+            ]
+        if not drained or drained[-1].get("retraces") != 0:
+            _say(f"FAIL: post-warmup retraces in the relaunched replica: "
+                 f"{drained and drained[-1].get('retraces')}")
+            return 1
+        _say("PASS replica: SIGKILL detected, bundled, relaunched "
+             "bit-exact, drained with 0 post-warmup retraces")
+        return 0
+    finally:
+        if sup.proc is not None and sup.proc.poll() is None:
+            sup.proc.kill()
+            sup.proc.wait()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_dist.fleet.tenancy_drill",
+        description="SLO-aware train+serve co-scheduling drill (CPU)",
+    )
+    p.add_argument("--workdir", required=True)
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--shrink_to", type=int, default=4)
+    p.add_argument("--model", default="vit_tiny")
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--steps_per_epoch", type=int, default=8)
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--tick_s", type=float, default=0.25,
+                   help="cycle phase: wall seconds per scheduler tick")
+    p.add_argument(
+        "--phase", choices=("all", "policy", "cycle", "replica"),
+        default="all",
+        help="'policy' = the recorded diurnal replay (pure, fast); "
+             "'cycle' = the same day against a real trainer (jax "
+             "subprocesses, slow); 'replica' = SIGKILL a supervised "
+             "serving replica (jax subprocess); 'all' = every phase",
+    )
+    args = p.parse_args(argv)
+    os.makedirs(args.workdir, exist_ok=True)
+    if args.phase in ("all", "policy"):
+        rc = run_policy_phase(args)
+        if rc != 0:
+            return rc
+    if args.phase in ("all", "cycle"):
+        rc = run_cycle_phase(args)
+        if rc != 0:
+            return rc
+    if args.phase in ("all", "replica"):
+        rc = run_replica_phase(args)
+        if rc != 0:
+            return rc
+    _say("PASS: all requested phases")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -78,7 +78,11 @@ import jax
 
 from tpu_dist.obs import counters as counters_lib
 
-SCHEMA_VERSION = 13  # v13 (additive): 'tune' records — the --tune_report
+SCHEMA_VERSION = 14  # v14 (additive): 'tenancy' records — the fleet
+#                      scheduler's per-tick chip-accounting snapshots
+#                      (alloc/free/pending; tpu_dist/fleet/scheduler.py)
+#                      whose sums make chip-second conservation exact;
+#                      v13 added 'tune' records — the --tune_report
 #                      overlap-autotuner knob application + tune.* gauges
 #                      (tpu_dist/analysis/overlap.py); v12 added 'plan'
 #                      records — the --auto_shard chosen plan + TD119

@@ -312,6 +312,12 @@ KEY_GAUGES = (
     # headroom fraction — a sick worker that was about to OOM says so
     ("mem.peak_bytes_in_use", "peak_hbm_B", "g"),
     ("mem.headroom_frac", "hbm_free", ".1%"),
+    # the serving layer (serve/slo.py): a sick SERVING replica's report
+    # must say WHY — was the queue exploding, was availability gone, was
+    # the p99 bound blown — not just that the process wedged
+    ("serve.queue_depth", "queue", "g"),
+    ("serve.availability", "avail", ".1%"),
+    ("serve.latency_p99_ms", "p99_ms", ".1f"),
 )
 
 

@@ -173,7 +173,12 @@ def fleet_move_phrase(rec: dict) -> str:
             phrase += f" (toward {rec['for_run']})"
     else:
         phrase = "?"
-    return phrase + f" ({rec.get('chips')} chip(s))"
+    phrase += f" ({rec.get('chips')} chip(s))"
+    if rec.get("preempt"):
+        # an SLO-breach preemption (multi-tenant pod): the move was
+        # demanded by a serving breach, not offered by a stalled donor
+        phrase += " [SLO preemption]"
+    return phrase
 
 
 # -- offline: fold a run's JSONL records back into one ledger ---------------

@@ -30,7 +30,7 @@ from tpu_dist.obs import memory as memory_lib
 #: kinds summarized; their unknown kinds are skipped with a count — the
 #: forward-compat contract that lets v3 tooling read v4 logs and vice
 #: versa (every schema bump is additive).
-SUPPORTED_SCHEMA = 13
+SUPPORTED_SCHEMA = 14
 
 #: Record kinds this reader folds into the report. Anything else is
 #: counted into ``skipped_kinds`` — never an error, never silent.
@@ -38,7 +38,7 @@ KNOWN_KINDS = frozenset((
     "train_epoch", "eval", "straggler", "anomaly", "device_stats",
     "auto_recover", "spans", "goodput", "profile", "alert",
     "profile_analysis", "resume", "fleet", "postmortem", "serve",
-    "memory", "plan", "tune",
+    "memory", "plan", "tune", "tenancy",
 ))
 
 
@@ -63,6 +63,18 @@ def load_records(path: str) -> Tuple[List[dict], int]:
     return records, bad
 
 
+def _tenancy_audit(snapshots: List[dict]) -> dict:
+    """The exact chip-second conservation audit over the ``tenancy``
+    snapshots (fleet/scheduler.py owns the arithmetic; imported lazily —
+    both modules are jax-free but obs must not import fleet at module
+    load)."""
+    from tpu_dist.fleet.scheduler import audit_chip_seconds
+
+    return audit_chip_seconds(
+        [{**s, "kind": "tenancy"} for s in snapshots]
+    )
+
+
 def summarize(records: List[dict], bad_lines: int = 0) -> dict:
     """The per-epoch report: throughput, step-time percentiles, data-stall
     fraction, MFU, counter deltas (vs the previous epoch's snapshot), eval,
@@ -85,6 +97,7 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
     oom_events: List[dict] = []      # parsed RESOURCE_EXHAUSTED crashes
     plan_records: List[dict] = []    # --auto_shard plan / TD119 drift (v12)
     tune_records: List[dict] = []    # --tune_report knob application (v13)
+    tenancy_snapshots: List[dict] = []  # per-tick chip accounting (v14)
     dstats: dict = {}  # epoch -> per-epoch device_stats aggregate
     recoveries = 0
     prev_counters: Optional[dict] = None
@@ -179,6 +192,17 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
                 for k in ("tick", "action", "donor", "recipient", "for_run",
                           "chips", "alloc_before", "alloc_after",
                           "pending_after", "reason", "inputs")
+                if rec.get(k) is not None
+            })
+        elif kind == "tenancy":
+            # a per-tick chip-accounting snapshot (schema v14,
+            # fleet/scheduler.py): every run's allocation + the free and
+            # pending pools — the raw material of the exact chip-second
+            # conservation audit
+            tenancy_snapshots.append({
+                k: rec.get(k)
+                for k in ("tick", "alloc", "free", "pending",
+                          "total_chips", "run_kinds")
                 if rec.get(k) is not None
             })
         elif kind == "postmortem":
@@ -381,6 +405,13 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
             }
             if plan_records else None
         ),
+        "tenancy_snapshots": tenancy_snapshots,
+        "tenancy": (
+            # the gating view of the multi-tenant pod: the exact
+            # chip-second conservation audit over every snapshot seen
+            _tenancy_audit(tenancy_snapshots)
+            if tenancy_snapshots else None
+        ),
         "tune_records": tune_records,
         "tune": (
             # the gating view of the tuner layer: the last application
@@ -485,6 +516,23 @@ def format_text(report: dict) -> str:
                 + "]"
                 if fd.get("alloc_before") and fd.get("alloc_after") else ""
             )
+        )
+    ten = report.get("tenancy")
+    if ten:
+        lines.append(
+            f"tenancy: {ten['n_ticks']} tick(s) × {ten['total_chips']} "
+            "chip(s) — "
+            + (
+                "chip-seconds conserved exactly"
+                if ten.get("conserved")
+                else "CHIP-SECOND CONSERVATION VIOLATED"
+            )
+            + " ["
+            + ", ".join(
+                f"{r}:{v:g}" for r, v in (ten.get("per_run") or {}).items()
+            )
+            + f", free:{ten.get('free_chip_s', 0):g}"
+            + f", pending:{ten.get('pending_chip_s', 0):g}]"
         )
     hdr = (
         f"{'epoch':>5} {'img/s':>9} {'epoch_s':>8} {'p50_ms':>8} "

@@ -225,6 +225,24 @@ class TailState:
                         if rec.get("reason") else ""
                     )
                 )
+            elif kind == "tenancy":
+                # a per-tick chip-accounting snapshot (schema v14):
+                # silent while the books balance — every tick would be
+                # noise — but a conservation violation is front-page
+                alloc = rec.get("alloc") or {}
+                accounted = (
+                    sum(int(a) for a in alloc.values())
+                    + int(rec.get("free") or 0)
+                    + int(rec.get("pending") or 0)
+                )
+                total = int(rec.get("total_chips") or 0)
+                if accounted != total:
+                    self._event(
+                        f"tenancy VIOLATION: tick {rec.get('tick')} "
+                        f"accounts {accounted} of {total} chip(s) "
+                        f"(alloc {alloc}, free {rec.get('free')}, "
+                        f"pending {rec.get('pending')})"
+                    )
             elif kind == "serve":
                 # a serving SLO window (schema v10) or a mid-serve event
                 # (retrace) — one line each, the serving analogue of the

@@ -16,6 +16,12 @@ Subcommands::
         histogram invariants, and the ``obs compare --slo`` exit
         contract (injected regression exits 1, an improvement exits 0).
 
+    replica --ckpt C --workdir D [...]
+        One supervised serving replica (``serve/replica.py``): restore
+        ladder → warmup → paced synthetic serving with the heartbeat /
+        flight-ring / exposition kit armed; SIGTERM runs the graceful
+        shed→drain→sweep vacate. ``ReplicaSupervisor`` spawns these.
+
 Exit codes: 0 ok, 1 unusable input / failed drill, 2 bad invocation.
 The report path is pure file crunching — no device, no backend.
 """
@@ -43,7 +49,18 @@ def main(argv=None) -> int:
     )
     d.add_argument("--workdir", default="/tmp/serve_drill")
     d.add_argument("--format", choices=("text", "json"), default="text")
-    args = ap.parse_args(argv)
+    sub.add_parser(
+        "replica", add_help=False,
+        help="one supervised serving replica (serve/replica.py)",
+    )
+    args, rest = ap.parse_known_args(argv)
+
+    if args.cmd == "replica":
+        from tpu_dist.serve import replica as replica_lib
+
+        return replica_lib.main(rest)
+    if rest:
+        ap.error(f"unrecognized arguments: {' '.join(rest)}")
 
     if args.cmd == "drill":
         from tpu_dist.serve import drill as drill_lib

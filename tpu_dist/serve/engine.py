@@ -351,6 +351,9 @@ class ServingEngine:
         history=None,
         exporter=None,
         clock: Optional[Callable[[], float]] = None,
+        heartbeat_file: Optional[str] = None,
+        rank: int = 0,
+        max_queue: Optional[int] = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -361,6 +364,24 @@ class ServingEngine:
         self._clock = clock or time.perf_counter
         self._queue: collections.deque = collections.deque()
         self.stats = slo_lib.ServeStats(deadline_s=deadline_s)
+        # liveness: the pump loop beats the SAME per-rank heartbeat file
+        # discipline the trainer uses (obs/heartbeat.py per_rank_path),
+        # so the launcher watchdog, obs pod and the fleet scheduler's
+        # read_signals cover serving replicas instead of alive=None
+        self._heartbeat = None
+        if heartbeat_file:
+            from tpu_dist.obs import heartbeat as heartbeat_lib
+
+            self._heartbeat = heartbeat_lib.Heartbeat(
+                heartbeat_lib.per_rank_path(heartbeat_file, rank)
+            )
+        self._pumps = 0
+        # admission control: while shedding (the chip-vacate window) or
+        # past the queue cap, submit() refuses instead of queueing —
+        # graceful degradation beats a queue explosion
+        self.max_queue = max_queue
+        self._shedding = False
+        self._shed_reason = ""
         self.history = history
         self.exporter = exporter
         self._slo = (
@@ -430,16 +451,43 @@ class ServingEngine:
 
     # -- request flow -------------------------------------------------------
 
+    def set_shedding(self, on: bool, reason: str = "") -> None:
+        """Toggle load-shedding admission: while on, :meth:`submit`
+        refuses new requests (``req.ok`` False, ``serve.shed`` counted)
+        and the pump keeps draining what was already admitted. The
+        vacate window arms this — a replica set about to lose (or in
+        the middle of re-acquiring) chips degrades gracefully instead
+        of exploding its queue."""
+        self._shedding = bool(on)
+        self._shed_reason = reason if on else ""
+        counters_lib.set_gauge("serve.shedding", 1 if on else 0)
+
+    @property
+    def shedding(self) -> bool:
+        return self._shedding
+
     def submit(self, payload: np.ndarray, *, id=None,
                arrival_s: Optional[float] = None) -> Request:
         """Enqueue one request. ``arrival_s`` overrides the clock reading
-        (trace replay); ``payload`` is one sample (no batch dim)."""
+        (trace replay); ``payload`` is one sample (no batch dim).
+
+        Admission control: while shedding is on, or the queue sits at
+        ``max_queue``, the request is REFUSED — returned immediately
+        with ``ok`` False and no result, counted as ``serve.shed``,
+        never entering the queue or the latency histograms (the p99
+        describes admitted work; refusals are their own ledger)."""
         self._seq += 1
         req = Request(
             id if id is not None else self._seq,
             np.asarray(payload),
             self._clock() if arrival_s is None else arrival_s,
         )
+        if self._shedding or (
+            self.max_queue is not None and len(self._queue) >= self.max_queue
+        ):
+            self.stats.on_shed(len(self._queue))
+            counters_lib.inc("serve.shed")
+            return req
         self._queue.append(req)
         self.stats.on_submit(len(self._queue))
         counters_lib.inc("serve.requests")
@@ -450,8 +498,12 @@ class ServingEngine:
 
     def pump(self) -> List[Request]:
         """Assemble and run ONE batch from the queue head (empty queue →
-        no-op). Returns the completed requests with results and phase
-        latencies filled in."""
+        no-op, but the heartbeat still beats: an idle replica is alive).
+        Returns the completed requests with results and phase latencies
+        filled in."""
+        self._pumps += 1
+        if self._heartbeat is not None:
+            self._heartbeat.beat(step=self._pumps, phase="serve")
         if not self._queue:
             return []
         t_assemble = self._clock()
@@ -527,6 +579,15 @@ class ServingEngine:
                 break
             done.extend(self.pump())
         return done
+
+    def sweep_heartbeat(self) -> None:
+        """Remove the replica's heartbeat file — the clean-exit signal
+        (an ABSENT beat reads as a clean exit; a stale one as a wedge).
+        The replica entrypoint calls this on the way out of a graceful
+        SIGTERM drain; a SIGKILL leaves the file behind, which is
+        exactly what lets the supervisor tell the two apart."""
+        if self._heartbeat is not None:
+            self._heartbeat.sweep()
 
     # -- observation windows -------------------------------------------------
 

@@ -1,0 +1,209 @@
+"""A supervised serving replica process (``python -m tpu_dist.serve
+replica`` — docs/serving.md "Replica supervision").
+
+The process :class:`~tpu_dist.serve.supervisor.ReplicaSupervisor`
+spawns: it loads weights through the CRC-verified restore ladder
+(:func:`~tpu_dist.serve.engine.load_serving_state` — newest→oldest,
+quarantine, elastic Remapper), warms the bucket ladder, baselines the
+compile watcher, and serves a paced synthetic load while arming the
+full forensic kit — per-rank heartbeat (the engine pump beats it),
+flight ring, OpenMetrics exposition, history JSONL — so a SIGKILL
+leaves exactly the evidence ``obs postmortem`` bundles, and a SIGTERM
+runs the graceful vacate: **shed → drain admitted work → final window
+→ sweep heartbeat → exit 0**.
+
+Every incarnation appends machine-readable lines to a status JSONL
+(``--status_file``): a ``ready`` line carries the loaded weights'
+CRC32 digest (the relaunch-restores-bit-exact proof pins two
+incarnations' digests equal) and a ``serving``/``drained`` line carries
+the post-warmup retrace count (the zero-retrace proof). The payloads
+are deterministic per sequence number, so two incarnations serve
+byte-identical work.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import zlib
+from typing import Optional
+
+import numpy as np
+
+#: Defaults shared with serve/drill.py's miniature model so a replica
+#: warms its ladder in seconds on CPU.
+IMAGE_SHAPE = (16, 16, 3)
+MAX_BATCH = 4
+
+
+def weights_digest(params, bn_state) -> str:
+    """CRC32 over every leaf's bytes in deterministic key order — the
+    bit-exactness fingerprint two incarnations must share."""
+    import jax
+
+    crc = 0
+    for tree in (params, bn_state):
+        leaves = sorted(
+            jax.tree_util.tree_flatten_with_path(tree)[0],
+            key=lambda kv: jax.tree_util.keystr(kv[0]),
+        )
+        for path, leaf in leaves:
+            arr = np.ascontiguousarray(np.asarray(leaf))
+            crc = zlib.crc32(jax.tree_util.keystr(path).encode(), crc)
+            crc = zlib.crc32(arr.tobytes(), crc)
+    return f"{crc:08x}"
+
+
+def _status(path: Optional[str], **fields) -> None:
+    if not path:
+        return
+    fields.setdefault("ts", round(time.time(), 3))
+    fields.setdefault("pid", os.getpid())
+    # tpu-dist: ignore[TD002] — a replica is a single supervised process
+    # writing its OWN status file (the path is per-replica, like the
+    # per-rank heartbeat); there is no rank fan-out to guard against
+    with open(path, "a") as f:
+        f.write(json.dumps(fields) + "\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_dist.serve replica",
+        description="one supervised serving replica (drill-sized model)",
+    )
+    ap.add_argument("--ckpt", required=True,
+                    help="checkpoint file or --ckpt_dir (restore ladder)")
+    ap.add_argument("--workdir", required=True,
+                    help="heartbeat/ring/exposition/history live here")
+    ap.add_argument("--status_file", default=None,
+                    help="append ready/serving/drained JSONL lines here")
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--max_batch", type=int, default=MAX_BATCH)
+    ap.add_argument("--deadline_ms", type=float, default=500.0)
+    ap.add_argument("--max_queue", type=int, default=64)
+    ap.add_argument("--serve_n", type=int, default=0,
+                    help="exit 0 after N completions (0 = until SIGTERM)")
+    ap.add_argument("--pace_s", type=float, default=0.0,
+                    help="sleep between submits (0 = as fast as possible)")
+    ap.add_argument("--window_every", type=int, default=16,
+                    help="record_window every N pumps")
+    ap.add_argument("--wedge_after", type=int, default=0,
+                    help="TEST HOOK: stop pumping (but stay alive) after "
+                         "N completions — fakes a wedged pump loop")
+    args = ap.parse_args(argv)
+
+    os.makedirs(args.workdir, exist_ok=True)
+    status = args.status_file or os.path.join(
+        args.workdir, "replica_status.jsonl"
+    )
+
+    from tpu_dist.metrics.history import MetricsHistory
+    from tpu_dist.obs import counters as counters_lib
+    from tpu_dist.obs import export as export_lib
+    from tpu_dist.obs import flight as flight_lib
+    from tpu_dist.obs import heartbeat as heartbeat_lib
+    from tpu_dist.resilience import preemption
+    from tpu_dist.serve import slo as slo_lib
+    from tpu_dist.serve.drill import _drill_model
+    from tpu_dist.serve.engine import ServingEngine, load_serving_state
+
+    counters_lib.reset()
+    token = preemption.install()  # SIGTERM → cooperative vacate flag
+    ring = flight_lib.FlightRecorder(
+        heartbeat_lib.per_rank_path(
+            os.path.join(args.workdir, flight_lib.RING_NAME), args.rank
+        ),
+        rank=args.rank, run_id="serve-replica",
+    )
+    ring.install_excepthooks()
+    history = MetricsHistory(
+        os.path.join(args.workdir, "replica.jsonl"),
+        run_id="serve-replica",
+    )
+    exporter = export_lib.MetricsExporter(
+        textfile=heartbeat_lib.per_rank_path(
+            os.path.join(args.workdir, "metrics.prom"), args.rank
+        ),
+        rank=args.rank,
+    )
+
+    model = _drill_model()
+    loaded = load_serving_state(args.ckpt, model)
+    digest = weights_digest(loaded["params"], loaded["bn_state"])
+    engine = ServingEngine(
+        model, loaded["params"], loaded["bn_state"],
+        max_batch=args.max_batch,
+        deadline_s=args.deadline_ms / 1e3,
+        slo_rules=slo_lib.load_slo_rules("default"),
+        history=history,
+        exporter=exporter,
+        heartbeat_file=os.path.join(args.workdir, "hb.json"),
+        rank=args.rank,
+        max_queue=args.max_queue,
+    )
+    compiles = engine.warmup(IMAGE_SHAPE)
+    retraces_baseline = counters_lib.get("compile.retraces")
+    _status(
+        status, event="ready", weights_digest=digest,
+        ckpt=loaded["path"], warmup_compiles=compiles,
+        remapped=bool(loaded["remapped"]),
+    )
+
+    rng = np.random.default_rng(1234)
+    # one deterministic payload pool reused round-robin: incarnation k
+    # and incarnation k+1 serve byte-identical work
+    pool = rng.standard_normal((64,) + IMAGE_SHAPE).astype(np.float32)
+    served = 0
+    pumps = 0
+    try:
+        while True:
+            if preemption.requested():
+                # the vacate window: refuse new work, drain what was
+                # admitted, close the books, sweep the beat — exit 0
+                engine.set_shedding(True, "vacate (SIGTERM)")
+                engine.drain()
+                scalars = engine.record_window()
+                _status(
+                    status, event="drained",
+                    served=served,
+                    retraces=counters_lib.get("compile.retraces")
+                    - retraces_baseline,
+                    shed=int(scalars.get("serve.shed", 0)),
+                )
+                return 0
+            if args.serve_n and served >= args.serve_n:
+                _status(
+                    status, event="serving", served=served,
+                    retraces=counters_lib.get("compile.retraces")
+                    - retraces_baseline,
+                )
+                if args.wedge_after and served >= args.wedge_after:
+                    # fake a wedge: alive, beating nothing, pumping
+                    # nothing — the supervisor's staleness detector is
+                    # what this hook exists to exercise
+                    while not preemption.requested():
+                        time.sleep(0.05)
+                    return 0
+                return 0
+            engine.submit(pool[served % len(pool)], id=served)
+            done = engine.pump()
+            served += len(done)
+            pumps += 1
+            if args.window_every and pumps % args.window_every == 0:
+                engine.record_window()
+            if args.pace_s:
+                time.sleep(args.pace_s)
+    finally:
+        engine.record_window()
+        engine.sweep_heartbeat()
+        history.close()
+        ring.close()
+        preemption.restore(token)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

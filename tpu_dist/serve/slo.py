@@ -191,6 +191,7 @@ class ServeStats:
         self.submitted = 0
         self.completed = 0
         self.good = 0          # met the deadline (or all, without one)
+        self.shed = 0          # refused at admission (load shedding)
         self.batches = 0
         self.padded_slots = 0  # bucket slots carrying padding, summed
         self.occupancy_sum = 0.0  # Σ real/bucket per batch
@@ -201,6 +202,14 @@ class ServeStats:
 
     def on_submit(self, depth: int) -> None:
         self.submitted += 1
+        self.set_queue_depth(depth)
+
+    def on_shed(self, depth: int) -> None:
+        """One request refused at admission (vacate-window shedding or a
+        queue-depth cap). Shed requests never enter ``submitted`` — the
+        latency histograms and availability describe ADMITTED work only,
+        so shedding degrades the ``serve.shed`` counter, not the p99."""
+        self.shed += 1
         self.set_queue_depth(depth)
 
     def set_queue_depth(self, depth: int) -> None:
@@ -240,6 +249,7 @@ class ServeStats:
         out: Dict[str, float] = {
             "serve.requests": self.submitted,
             "serve.completed": self.completed,
+            "serve.shed": self.shed,
             "serve.batches": self.batches,
             "serve.queue_depth": self.queue_depth,
             "serve.queue_depth_max": self.queue_depth_max,

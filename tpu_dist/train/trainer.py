@@ -1011,6 +1011,11 @@ class Trainer:
         self._resume_step = 0  # >0 only after restoring a mid-epoch snapshot
         self._resume_examples = 0  # >0 only on an ELASTIC mid-epoch resume
         #                            (consumed-prefix offset; sampler.set_offset)
+        # the snapshot's final-step metrics: replayed when a resumed epoch
+        # has zero steps left (the interrupt landed after the epoch's last
+        # step), so the epoch record still matches the uninterrupted run
+        self._resume_metrics = None
+        self._step_metrics = None  # (epoch, steps_done, device metrics)
         self._epoch_start_examples = 0  # the running epoch's entry offset
         # logical param length L — the world-size-independent coordinate
         # every elastic flat layout (ZeRO-1 opt vectors, EF residuals) is
@@ -1343,13 +1348,33 @@ class Trainer:
             self._epoch_start_examples + steps_done * cfg.batch_size,
             len(self.train_data[0]),
         )
-        return {
+        out = {
             "mid_epoch_step": int(steps_done),
             "mid_epoch_batch_size": cfg.batch_size,
             "mid_epoch_seed": cfg.seed or 0,
             "mid_epoch_procs": mesh_lib.process_count(),
             "mid_epoch_examples": int(consumed),
         }
+        # carry the final dispatched step's metrics when they describe
+        # exactly this position: an interrupt that lands after an epoch's
+        # LAST step resumes with nothing left to run, and without this
+        # stamp the epoch record (loss above all) would silently vanish
+        stamped = self._step_metrics
+        prog = self._progress
+        if (
+            stamped is not None
+            and stamped[0] == prog[1]
+            and stamped[1] == int(steps_done)
+        ):
+            try:
+                out["mid_epoch_metrics"] = _fetch_metrics(stamped[2])
+            except RuntimeError:  # tpu-dist: ignore[TD006] — best-effort
+                # garnish on the emergency snapshot: a donated/deleted
+                # device buffer must never block the save itself (the
+                # record then degrades to the pre-fix lossless-but-
+                # lossy-logging behavior instead of dying mid-SIGTERM)
+                pass
+        return out
 
     def _check_ckpt_layout(self, path: str) -> None:
         self._check_ckpt_meta(ckpt_lib.read_meta(path), path)
@@ -1636,6 +1661,7 @@ class Trainer:
                 ):
                     # catch the post-retrace steps on the device timeline
                     self._profiler.arm("retrace")
+            self._step_metrics = (epoch, step + 1, metrics)
             self._progress = (new_state, epoch, step + 1, False)
             self.state = new_state
             images_seen += cfg.batch_size
@@ -1725,7 +1751,16 @@ class Trainer:
         # end-of-epoch guard: catches divergence between logged steps BEFORE
         # fit() writes a checkpoint of the poisoned state. One fetch, reused
         # for the returned epoch metrics below.
-        out = _fetch_metrics(metrics) if metrics else {}
+        if metrics:
+            out = _fetch_metrics(metrics)
+        elif steps_run == 0 and (start_step or start_examples):
+            # the interrupt landed after this epoch's LAST step: nothing
+            # was left to run, so replay the snapshot's stamped final-step
+            # metrics — the epoch record (loss above all) must match the
+            # uninterrupted run, not vanish
+            out = dict(self._resume_metrics or {})
+        else:
+            out = {}
         if cfg.nan_guard and out and not np.isfinite(out["loss"]):
             raise TrainingDivergedError(
                 f"non-finite loss {out['loss']} at end of epoch {epoch} "
@@ -2476,6 +2511,11 @@ class Trainer:
         # this step instead of starting the next epoch
         self._resume_step = int(meta.get("mid_epoch_step", 0))
         self._resume_examples = 0
+        # the snapshot's final-step metrics (when stamped): replayed by
+        # train_epoch iff the resumed epoch has zero steps left to run
+        self._resume_metrics = (
+            meta.get("mid_epoch_metrics") if self._resume_step else None
+        )
         if self._resume_step:
             from tpu_dist.elastic.errors import (  # noqa: PLC0415
                 ConfigMismatchError,
