@@ -1,6 +1,6 @@
 # Convenience targets; CI / the driver call the underlying commands directly.
 
-.PHONY: test quick bench csrc clean lint shard-report plan-report tune-overlap ckpt-bench pod-report monitor profile-report elastic-drill fleet-drill postmortem-drill serve-drill tenancy-drill serve-report memory-report
+.PHONY: test quick bench csrc clean lint shard-report plan-report tune-overlap ckpt-bench pod-report monitor profile-report elastic-drill fleet-drill postmortem-drill serve-drill tenancy-drill hub-drill serve-report memory-report
 
 csrc:
 	$(MAKE) -C tpu_dist/csrc
@@ -126,6 +126,18 @@ serve-drill:
 #   make tenancy-drill [WORKDIR=/tmp/tenancy_drill] [PHASE=all|policy|cycle|replica]
 tenancy-drill:
 	python -m tpu_dist.fleet.tenancy_drill --workdir $(or $(WORKDIR),/tmp/tenancy_drill) --phase $(or $(PHASE),all)
+
+# The pod telemetry plane proof (docs/observability.md "Pod telemetry
+# hub"): the diurnal replay arbitrated off ONE TelemetryHub fan-in
+# (federated page round-trips with per-run labels + pod rollups), then
+# the real-trainer cycle asserting the full causal chain — one
+# decision_id spanning scheduler ledger -> allocation file/relaunch
+# env -> resume record -> donor flight ring -> hub exposition, with
+# the serve-preempt gap charged to preempt_for_serve_s and the goodput
+# bucket partition exact:
+#   make hub-drill [WORKDIR=/tmp/hub_drill]
+hub-drill:
+	python -m tpu_dist.fleet.tenancy_drill --workdir $(or $(WORKDIR),/tmp/hub_drill) --phase hub
 
 # Offline serving SLO report over a run's serve records:
 #   make serve-report LOG=serve.jsonl

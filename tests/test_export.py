@@ -803,7 +803,7 @@ def test_e2e_live_run_scrape_matches_jsonl_and_stall_rule_fires(tmp_path):
     alerts = [r for r in records if r.get("kind") == "alert"]
     assert alerts and alerts[0]["rule"] == "stall_watch"
     assert alerts[0]["metric"] == "data_stall_frac"
-    assert records[0]["schema_version"] == 12
+    assert records[0]["schema_version"] == 15  # v15: causal decision tracing (ISSUE 19)
     # ...and the exporter gauge flipped (active through the final window:
     # cooldown 0 + every epoch breaches, so the last exposition holds 1)
     assert final['tpu_dist_alert_active{rule="stall_watch"}'] == 1.0

@@ -509,7 +509,7 @@ def test_postmortem_schema_literal_pinned_to_history():
     track the real schema — this pin is the drift alarm."""
     from tpu_dist.metrics.history import SCHEMA_VERSION
 
-    assert postmortem_lib.POSTMORTEM_SCHEMA_VERSION == SCHEMA_VERSION == 14
+    assert postmortem_lib.POSTMORTEM_SCHEMA_VERSION == SCHEMA_VERSION == 15
 
 
 def test_rank_summary_shared_and_numeric_sort():

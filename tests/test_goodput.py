@@ -332,9 +332,9 @@ def test_summarize_skips_unknown_kinds_with_count():
         _goodput_rec("r", 2.0, 2.0, epoch=0, window_s=2.0,
                      productive_s=1.5, unattributed_s=0.5),
         # a future schema's record kinds: skipped, counted, noted
-        {"kind": "hologram", "epoch": 0, "schema_version": 15, "ts": 3.0},
-        {"kind": "hologram", "epoch": 1, "schema_version": 15, "ts": 4.0},
-        {"kind": "quantum_foam", "schema_version": 15, "ts": 5.0},
+        {"kind": "hologram", "epoch": 0, "schema_version": 16, "ts": 3.0},
+        {"kind": "hologram", "epoch": 1, "schema_version": 16, "ts": 4.0},
+        {"kind": "quantum_foam", "schema_version": 16, "ts": 5.0},
     ]
     report = summarize(records)
     assert report["skipped_kinds"] == {"hologram": 2, "quantum_foam": 1}
@@ -399,7 +399,7 @@ def test_compare_goodput_gate_exit_contract(tmp_path, capsys):
     assert obs_main(["compare", base, base, "--goodput", "--format", "json"]) == 0
     result = json.loads(capsys.readouterr().out)
     assert {r["metric"] for r in result["rows"]} == {
-        "goodput_frac", "data_stall_frac"
+        "goodput_frac", "data_stall_frac", "preempt_for_serve_s"
     }
     # full-metric compare also sees the fraction (additive, skipped when
     # a pre-v4 log lacks it)

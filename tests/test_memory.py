@@ -303,7 +303,7 @@ def test_cpu_fit_logs_memory_record_with_exact_reconciliation(tmp_path):
     mems = [r for r in records if r.get("kind") == "memory"]
     assert len(mems) == 1, [r.get("kind") for r in records]
     m = mems[0]
-    assert m["schema_version"] == 14
+    assert m["schema_version"] == 15
     rc = m["reconciliation"]
     assert (
         rc["attributed_bytes"] + rc["unattributed_bytes"]
@@ -557,23 +557,23 @@ def test_td115_memory_ledger_noop_gate():
 # -- schema v11 pins ---------------------------------------------------------
 
 
-def test_schema_v14_pins_and_future_kind_tolerance():
+def test_schema_v15_pins_and_future_kind_tolerance():
     from tpu_dist.metrics.history import SCHEMA_VERSION
     from tpu_dist.obs import summarize as summ
     from tpu_dist.obs.postmortem import POSTMORTEM_SCHEMA_VERSION
     from tpu_dist.fleet.scheduler import FLEET_SCHEMA_VERSION
 
-    assert SCHEMA_VERSION == POSTMORTEM_SCHEMA_VERSION == 14
-    assert FLEET_SCHEMA_VERSION == 14
-    assert summ.SUPPORTED_SCHEMA == 14
+    assert SCHEMA_VERSION == POSTMORTEM_SCHEMA_VERSION == 15
+    assert FLEET_SCHEMA_VERSION == 15
+    assert summ.SUPPORTED_SCHEMA == 15
     assert "memory" in summ.KNOWN_KINDS
     assert "tenancy" in summ.KNOWN_KINDS  # v14: the co-scheduling ledger
-    # a v15 log's unknown kind: skipped WITH a count, never an error
+    # a v16 log's unknown kind: skipped WITH a count, never an error
     report = summ.summarize([
         {"kind": "train_epoch", "epoch": 0, "schema_version": 11,
          "ts": 1.0, "rel_s": 1.0, "epoch_time": 1.0,
          "images_per_sec": 10.0, "loss": 1.0},
-        {"kind": "mem_hologram", "schema_version": 15, "ts": 2.0},
+        {"kind": "mem_hologram", "schema_version": 16, "ts": 2.0},
     ])
     assert report["skipped_kinds"] == {"mem_hologram": 1}
     assert report["newer_schema_records"] == 1

@@ -240,7 +240,7 @@ def test_history_schema_run_id_rel_s_and_counters(tmp_path):
     lines = [json.loads(l) for l in open(path)]
     assert len(lines) == 2
     for rec in lines:
-        assert rec["schema_version"] == 14  # v14: tenancy records (ISSUE 18)
+        assert rec["schema_version"] == 15  # v15: causal decision tracing (ISSUE 19)
         assert rec["run_id"] == "cfg1234-99"
         assert isinstance(rec["rel_s"], float) and rec["rel_s"] >= 0
         assert "ts" in rec

@@ -597,12 +597,12 @@ def test_serve_records_render_in_summarize_and_tail(tmp_path):
 def test_serve_record_schema_v10_stamp(tmp_path):
     from tpu_dist.metrics.history import SCHEMA_VERSION, MetricsHistory
 
-    assert SCHEMA_VERSION == 14  # v14: 'tenancy' records (ISSUE 18)
+    assert SCHEMA_VERSION == 15  # v15: causal decision tracing (ISSUE 19)
     path = str(tmp_path / "h.jsonl")
     with MetricsHistory(path, run_id="s10") as h:
         h.log("serve", window_s=1.0, completed=4, latency_p50_ms=3.0)
     rec = json.loads(open(path).read())
-    assert rec["schema_version"] == 14 and rec["kind"] == "serve"
+    assert rec["schema_version"] == 15 and rec["kind"] == "serve"
 
 
 def test_serve_cli_report(tmp_path, capsys):

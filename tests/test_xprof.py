@@ -612,14 +612,14 @@ def test_xprof_cli_text_json_and_exit_codes(tmp_path, capsys):
 def test_history_schema_round_trip(tmp_path):
     from tpu_dist.metrics.history import SCHEMA_VERSION, MetricsHistory
 
-    assert SCHEMA_VERSION == 14  # v14: 'tenancy' records (ISSUE 18)
+    assert SCHEMA_VERSION == 15  # v15: causal decision tracing (ISSUE 19)
     path = str(tmp_path / "h.jsonl")
     with MetricsHistory(path, run_id="r9") as h:
         h.log("profile_analysis", epoch=0, reason="manual",
               device_busy_s=0.5, overlap_frac=0.4,
               categories={"matmul_conv": 0.5})
     rec = json.loads(open(path).read())
-    assert rec["schema_version"] == 14
+    assert rec["schema_version"] == 15
     assert rec["kind"] == "profile_analysis"
     assert rec["categories"] == {"matmul_conv": 0.5}
 
@@ -657,7 +657,7 @@ def test_e2e_trainer_capture_emits_analysis_record_and_gauges(tmp_path, capsys):
     analyses = [r for r in records if r["kind"] == "profile_analysis"]
     assert len(analyses) == 1, [r["kind"] for r in records]
     pa = analyses[0]
-    assert pa["schema_version"] == 14
+    assert pa["schema_version"] == 15
     assert pa.get("error") is None
     assert pa["device_busy_s"] > 0
     assert sum(pa["categories"].values()) == pytest.approx(

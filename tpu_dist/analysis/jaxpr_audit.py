@@ -1662,6 +1662,245 @@ def tenancy_arbitration_noop_violations(mesh=None) -> list[Violation]:
     return out
 
 
+def pod_hub_noop_violations(mesh=None) -> list[Violation]:
+    """TD123: the pod telemetry plane cost contract — trace the
+    data-parallel train step AND the serving forward step with nothing
+    armed, then arm the FULL telemetry plane exactly as a co-scheduled
+    pod runs it: two live run expositions (a healthy trainer, a
+    genuinely breached serve run) federated through ONE
+    :class:`TelemetryHub` pass with the fleet scheduler's own
+    exposition feeding the chip rollups, the arbiter consuming THAT
+    snapshot (``signals_from_hub`` — the one fan-in) and driven through
+    a sustained breach to a genuinely fired donate→grant pair SHARING
+    one ``decision_id``, the id read back off the allocation file,
+    stamped into a relaunch env by the supervisor helper, propagated
+    into a resume record, and charged by the goodput ledger to
+    ``preempt_for_serve_s`` with the bucket partition still exact —
+    and trace both steps again mid-audit. Both jaxprs must be
+    byte-identical: federation, causal tracing, and attribution are
+    host-side file arithmetic, and the moment someone routes a hub
+    scrape or a decision-id check through a compiled step, this trips.
+    The probe also asserts the plane actually RAN (two runs aggregated,
+    the federated page round-trips, the chain holds ONE id across every
+    artifact layer, the ledger partition is exact) — zero runs
+    aggregated or a chain with no propagated id is itself a
+    violation."""
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.elastic import supervisor as supervisor_lib
+    from tpu_dist.fleet import capacity as capacity_lib
+    from tpu_dist.fleet import scheduler as fleet_lib
+    from tpu_dist.obs import export as export_lib
+    from tpu_dist.obs import goodput as goodput_lib
+    from tpu_dist.obs import heartbeat as heartbeat_lib
+    from tpu_dist.obs import hub as hub_lib
+    from tpu_dist.serve import slo as slo_lib
+
+    m = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+    fn, args = _dp_setup(m, shard_weight_update=True)
+    base_train = str(jax.make_jaxpr(fn)(*args))
+
+    model = _AuditMLP()
+    params, bn = model.init(jax.random.PRNGKey(0))
+    x = jax.ShapeDtypeStruct((8, 2, 2, 3), jnp.float32)
+
+    def forward(p, s, images):
+        logits, _ = model.apply(p, s, images, train=False)
+        return logits
+
+    base_serve = str(jax.make_jaxpr(forward)(params, bn, x))
+
+    with tempfile.TemporaryDirectory(prefix="td123_") as td:
+        # -- arm: two live runs, one healthy and one breached ---------------
+        train_prom = os.path.join(td, "trainer.prom")
+        with open(train_prom, "w") as f:
+            f.write(export_lib.render({
+                "train.data_stall_frac": 0.02,
+                "goodput.goodput_frac": 0.9,
+            }))
+        train_hb = os.path.join(td, "trainer.hb")
+        heartbeat_lib.Heartbeat(train_hb).beat(force=True)
+
+        stats = slo_lib.ServeStats(deadline_s=0.05)
+        slo_engine = slo_lib.make_slo_engine(slo_lib.load_slo_rules("default"))
+        window: dict = {}
+        for _ in range(3):  # sustain=2 rules genuinely sustain
+            for _ in range(4):
+                stats.on_batch(3, 4)
+                stats.on_request_done(
+                    0.6, 0.45, {p: 0.1 for p in slo_lib.PHASES}
+                )
+            stats.set_queue_depth(6)
+            window = stats.scalars(window_s=1.0, completed_in_window=4)
+            slo_engine.observe(window)
+        svc_prom = os.path.join(td, "svc.prom")
+        with open(svc_prom, "w") as f:
+            f.write(export_lib.render(
+                window,
+                {"alert_active": slo_engine.active()},
+                histograms=stats.histogram_families(),
+            ))
+        svc_hb = os.path.join(td, "svc.hb")
+        heartbeat_lib.Heartbeat(svc_hb).beat(force=True)
+
+        # -- arm: hub-fed arbiter driven to a chained donate→grant ----------
+        fleet_prom = os.path.join(td, "fleet.prom")
+        sched = fleet_lib.FleetScheduler(
+            [
+                fleet_lib.RunSpec("trainer", 8, min_procs=2, kind="train"),
+                fleet_lib.RunSpec("svc", 4, min_procs=1, kind="serve"),
+            ],
+            allocations={"trainer": 8, "svc": 2},
+            fleet_dir=td,
+        )
+        hub = hub_lib.TelemetryHub(
+            [
+                hub_lib.RunSource(
+                    "trainer", metrics_file=train_prom,
+                    heartbeat_file=train_hb, kind="train",
+                ),
+                hub_lib.RunSource(
+                    "svc", metrics_file=svc_prom,
+                    heartbeat_file=svc_hb, kind="serve",
+                ),
+            ],
+            fleet_exposition=fleet_prom,
+        )
+        decisions: list = []
+        snap: dict = {}
+        for t in range(1, 5):
+            sched.write_exposition(fleet_prom)
+            snap = hub.collect()
+            decisions.extend(
+                sched.step(t, fleet_lib.signals_from_hub(snap))
+            )
+        sched.write_exposition(fleet_prom)
+        snap = hub.collect()  # scraped MID-AUDIT, post-preemption state
+        federated = hub.federated(snap)
+
+        # -- arm: the id crossing every artifact layer ----------------------
+        donate = next(
+            (d for d in decisions if d.get("action") == "donate"), {}
+        )
+        grant = next(
+            (d for d in decisions if d.get("action") == "grant"
+             and d.get("chained")), {}
+        )
+        did = donate.get("decision_id")
+        alloc_meta = capacity_lib.read_allocation_meta(
+            sched.allocation_path("trainer")
+        )
+        env: dict = {}
+        supervisor_lib.stamp_decision_env(
+            env, sched.allocation_path("trainer")
+        )
+        env_id = env.get(supervisor_lib.DECISION_ID_ENV)
+        env_cause = env.get(supervisor_lib.DECISION_CAUSE_ENV)
+        resume_rec = {
+            "kind": "resume", "run_id": "b", "ts": 130.0, "rel_s": 10.0,
+            "dp": 4, "prev_dp": 8, "resharded": True,
+            "decision_id": int(env_id) if env_id else None,
+            "decision_cause": env_cause,
+        }
+        ledger = goodput_lib.run_ledger([
+            {"kind": "goodput", "run_id": "a", "ts": 100.0, "final": True,
+             "productive_s": 50.0, "data_stall_s": 10.0, "elapsed_s": 60.0},
+            resume_rec,
+            {"kind": "goodput", "run_id": "b", "ts": 150.0, "final": True,
+             "productive_s": 20.0, "elapsed_s": 20.0},
+        ]) or {}
+
+        # re-trace with the WHOLE plane up: hub snapshot live, arbiter
+        # holding post-preemption state, env stamped, ledger folded
+        fn2, args2 = _dp_setup(m, shard_weight_update=True)
+        armed_train = str(jax.make_jaxpr(fn2)(*args2))
+        armed_serve = str(jax.make_jaxpr(forward)(params, bn, x))
+
+    out: list[Violation] = []
+    rollup = snap.get("rollup") or {}
+    partition_gap = abs(
+        sum(
+            ledger.get(f"{b}_s", 0.0) for b in goodput_lib.ALL_BUCKETS
+        ) - ledger.get("elapsed_s", -1.0)
+    )
+    ran = (
+        rollup.get("runs_aggregated") == 2  # vacuity guard: ZERO is a trip
+        and rollup.get("breach_count") == 1
+        and rollup.get("total_chips") == 10.0  # 8 + 2 initial allocations
+        and isinstance(rollup.get("last_decision_id"), float)
+        and int(rollup["last_decision_id"]) >= 1
+        and federated.endswith("# EOF\n")
+        and 'run="svc"' in federated
+        and "tpu_dist_pod_runs_aggregated 2" in federated
+        # the chain: ONE integer id across scheduler ledger, completion
+        # grant, allocation file, relaunch env, resume record
+        and isinstance(did, int)
+        and grant.get("decision_id") == did
+        and donate.get("cause") == "serve_breach"
+        and alloc_meta.get("decision_id") == did
+        and env_id == str(did)
+        and resume_rec["decision_id"] == did
+        # the attribution: the gap landed in preempt_for_serve_s and
+        # the bucket partition stayed EXACT
+        and ledger.get("preempt_for_serve_s") == 20.0
+        and partition_gap < 1e-6
+    )
+    if not ran:
+        out.append(
+            Violation(
+                "TD123",
+                "<jaxpr:pod_hub_noop>",
+                0,
+                "the TD123 probe armed the pod telemetry plane but it "
+                "did not actually run (fewer than two runs aggregated, "
+                "the federated page failed to round-trip, the "
+                "donate→grant pair never fired or split across two "
+                "decision ids, the id failed to propagate through the "
+                "allocation file / relaunch env / resume record, or the "
+                "goodput partition broke) — the armed-vs-off comparison "
+                "would be vacuous (tpu_dist/obs/hub.py contract)",
+                snippet="pod telemetry plane probe did not fire",
+            )
+        )
+    if base_train != armed_train:
+        out.append(
+            Violation(
+                "TD123",
+                "<jaxpr:pod_hub_noop>",
+                0,
+                "the traced train step CHANGED when the pod telemetry "
+                "plane was armed (federated hub scrape mid-audit, "
+                "hub-fed arbiter, full decision-id chain, serve-preempt "
+                "goodput attribution) — the telemetry plane must stay "
+                "host-side file arithmetic around the unmodified "
+                "compiled step (tpu_dist/obs/hub.py contract, "
+                "docs/observability.md 'Pod telemetry hub')",
+                snippet="jaxpr(train, hub_off) != jaxpr(train, hub_armed)",
+            )
+        )
+    if base_serve != armed_serve:
+        out.append(
+            Violation(
+                "TD123",
+                "<jaxpr:pod_hub_noop>",
+                0,
+                "the traced serving forward step CHANGED when the pod "
+                "telemetry plane was armed — a serve run being scraped "
+                "by the hub and preempted by a traced fleet decision "
+                "must serve the SAME compiled program it warmed "
+                "(tpu_dist/obs/hub.py contract, docs/observability.md "
+                "'Pod telemetry hub')",
+                snippet="jaxpr(serve, hub_off) != jaxpr(serve, hub_armed)",
+            )
+        )
+    return out
+
+
 def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     """Run every (or the named) registered case. Returns
     ``(report, violations)`` where report maps case → op counts.
@@ -1670,8 +1909,9 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     the TD105 fault-injection, TD106 telemetry, TD107 device-metrics,
     TD108 profiler-trigger, TD109 live-export/alerting, TD110
     capture-auto-analyze, TD111 elastic-resume, TD112 elastic-grow,
-    TD113 flight-recorder, TD114 serving-SLO, TD115 memory-ledger, and
-    TD122 tenancy-arbitration no-op invariants."""
+    TD113 flight-recorder, TD114 serving-SLO, TD115 memory-ledger,
+    TD122 tenancy-arbitration, and TD123 pod-telemetry-hub no-op
+    invariants."""
     report: dict = {}
     violations: list[Violation] = []
     for name in names if names is not None else registered_cases():
@@ -1715,6 +1955,9 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
         violations.extend(vs)
         vs = tenancy_arbitration_noop_violations(mesh)
         report["tenancy_arbitration_noop"] = {"identical": not vs}
+        violations.extend(vs)
+        vs = pod_hub_noop_violations(mesh)
+        report["pod_hub_noop"] = {"identical": not vs}
         violations.extend(vs)
     return report, violations
 

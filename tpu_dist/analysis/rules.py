@@ -303,6 +303,21 @@ RULES: dict[str, Rule] = {
             "docs/resilience.md 'Multi-tenant pod')",
         ),
         Rule(
+            "TD123",
+            "pod-telemetry-control-plane-only",
+            "the traced train step or the jitted serving forward CHANGED "
+            "when the pod telemetry plane was armed (two-run federated "
+            "hub scrape mid-audit, the arbiter fed from the hub snapshot, "
+            "a donate→grant pair chained under ONE decision_id propagated "
+            "through allocation file → relaunch env → resume record, the "
+            "serve-preempt gap charged to preempt_for_serve_s with the "
+            "bucket partition exact) — federation and causal tracing must "
+            "stay host-side file arithmetic, and a probe that aggregates "
+            "zero runs or loses the id mid-chain is vacuous "
+            "(tpu_dist/obs/hub.py, tpu_dist/fleet/scheduler.py, "
+            "docs/observability.md 'Pod telemetry hub')",
+        ),
+        Rule(
             "TD104",
             "quantized-wire-bytes-over-budget",
             "gradient-collective payload bytes of a quantized wire format "

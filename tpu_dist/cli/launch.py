@@ -415,6 +415,25 @@ def _run_round(
             f"the new world of {nproc}"
         )
 
+    # causal arbitration tracing: when this (re)launch is the actuation
+    # of a fleet decision, the allocation file carries the scheduler's
+    # decision_id/cause tokens — read ONCE per round and stamped into
+    # every child so the trainer's resume record, flight-ring slot, and
+    # goodput window can name the arbitration (stale values from the
+    # launcher's own env are cleared by the stamp helper)
+    from tpu_dist.elastic.supervisor import (  # noqa: PLC0415
+        DECISION_CAUSE_ENV,
+        DECISION_ID_ENV,
+        read_decision,
+    )
+
+    meta = read_decision(getattr(args, "elastic_capacity_file", None))
+    if restart > 0 and meta["decision_id"] is not None:
+        announce(
+            f"relaunch actuates fleet decision {meta['decision_id']}"
+            + (f" ({meta['cause']})" if meta["cause"] else "")
+        )
+
     try:
         for rank in range(nproc):
             env = dict(os.environ)
@@ -429,6 +448,17 @@ def _run_round(
             # (elastic.restarts gauge); round 0 stamps 0 so a child's env
             # never inherits a stale value from the launcher's own env
             env["TPU_DIST_ELASTIC_RESTARTS"] = str(restart)
+            # one meta read per ROUND (above), applied to every rank —
+            # a mid-loop allocation rewrite must not split the world
+            # across two decision ids
+            for key, val in (
+                (DECISION_ID_ENV, meta["decision_id"]),
+                (DECISION_CAUSE_ENV, meta["cause"]),
+            ):
+                if val is not None:
+                    env[key] = str(val)
+                else:
+                    env.pop(key, None)
             child = cmd + [
                 "--num_processes", str(nproc),
                 "--process_id", str(rank),

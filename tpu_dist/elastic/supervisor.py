@@ -59,6 +59,46 @@ from tpu_dist.resilience.retry import backoff_delays
 #: (a child preempted before its handler was installed).
 SURVIVOR_EXITS = frozenset({0, PREEMPTION_EXIT_CODE, -int(signal.SIGTERM)})
 
+#: Relaunch-env names for causal arbitration tracing: when a resize was
+#: fleet-initiated, the allocation file carries the scheduler's
+#: ``decision_id``/``cause`` metadata tokens and the launcher stamps
+#: them into every relaunched child — the trainer's resume record,
+#: flight-ring slot, and goodput window then name WHICH arbitration
+#: moved the run. A chip-loss resize (no scheduler involved) leaves the
+#: env unset, so the two causes are finally distinguishable downstream.
+DECISION_ID_ENV = "TPU_DIST_FLEET_DECISION_ID"
+DECISION_CAUSE_ENV = "TPU_DIST_FLEET_DECISION_CAUSE"
+
+
+def read_decision(capacity_file: Optional[str]) -> dict:
+    """The active arbitration metadata in the run's allocation file:
+    ``{"decision_id": int|None, "cause": str|None}`` — all-None when no
+    capacity file is configured, the file is absent/torn, or its writer
+    predates causal tracing. Never raises (the probe discipline)."""
+    if not capacity_file:
+        return {"decision_id": None, "cause": None}
+    from tpu_dist.fleet import capacity as capacity_lib
+
+    return capacity_lib.read_allocation_meta(capacity_file)
+
+
+def stamp_decision_env(env: dict, capacity_file: Optional[str]) -> dict:
+    """Stamp the active ``decision_id``/``cause`` (when any) into a
+    relaunch environment IN PLACE, clearing stale values otherwise — a
+    child relaunched after the arbitration window closed must not
+    inherit a dead id from the launcher's own environment. Returns the
+    metadata that was read, for the caller's round log."""
+    meta = read_decision(capacity_file)
+    for key, val in (
+        (DECISION_ID_ENV, meta["decision_id"]),
+        (DECISION_CAUSE_ENV, meta["cause"]),
+    ):
+        if val is not None:
+            env[key] = str(val)
+        else:
+            env.pop(key, None)
+    return meta
+
 
 @dataclasses.dataclass
 class RoundResult:

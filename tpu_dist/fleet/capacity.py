@@ -53,15 +53,48 @@ def read_allocation(path: str) -> Optional[int]:
         return None
 
 
-def write_allocation(path: str, n: int) -> None:
+def read_allocation_meta(path: str) -> dict:
+    """The causal-tracing tokens riding behind the allocation integer:
+    ``{"decision_id": int|None, "cause": str|None}`` (both None when
+    the file is absent/torn or was written by a pre-tracing writer —
+    the channel stays readable in both directions). Never raises."""
+    out = {"decision_id": None, "cause": None}
+    try:
+        with open(path) as f:
+            tokens = f.read().split()
+    except OSError:
+        return out
+    for tok in tokens[1:]:
+        if tok.startswith("decision="):
+            val = tok[len("decision="):]
+            if re.fullmatch(r"[0-9]+", val):
+                out["decision_id"] = int(val)
+        elif tok.startswith("cause="):
+            out["cause"] = tok[len("cause="):] or None
+    return out
+
+
+def write_allocation(
+    path: str, n: int,
+    decision_id: Optional[int] = None, cause: Optional[str] = None,
+) -> None:
     """Atomically publish allocation ``n`` (tmp + ``os.replace`` — a
     concurrent :func:`read_allocation` sees the old value or the new one,
-    never a torn write)."""
+    never a torn write). ``decision_id``/``cause`` append the causal-
+    tracing tokens (``N decision=7 cause=serve_breach``) —
+    :func:`read_allocation` only parses the leading integer, so every
+    pre-tracing reader keeps working; :func:`read_allocation_meta` and
+    the elastic supervisor's env stamping read the tokens back."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}"
+    line = f"{int(n)}"
+    if decision_id is not None:
+        line += f" decision={int(decision_id)}"
+        if cause:
+            line += f" cause={cause}"
     with open(tmp, "w") as f:
-        f.write(f"{int(n)}\n")
+        f.write(line + "\n")
     os.replace(tmp, path)
 
 

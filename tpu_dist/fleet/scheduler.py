@@ -8,12 +8,16 @@ TPUs) — so chips should sit where they buy goodput, not where the
 original submission happened to put them. This module is the arbiter:
 
 * **Sensors** — the per-run signals the obs stack already exports:
-  each run's OpenMetrics textfile (``--metrics_file``; scraped with
-  ``obs/export.py::scrape``) carries data-stall fraction, goodput
-  fraction, MFU, the serving gauges (queue depth, availability, p99
-  latency bound) and the active-alert gauges, and its heartbeat file
-  answers liveness. Nothing here instruments a run — the scheduler is a
-  pure reader of artifacts that exist anyway.
+  each run's OpenMetrics exposition carries data-stall fraction,
+  goodput fraction, MFU, the serving gauges (queue depth, availability,
+  p99 latency bound) and the active-alert gauges, and its heartbeat
+  file answers liveness. The scrape itself lives in the pod telemetry
+  hub (``obs/hub.py::sample_run`` — ONE fan-in for the arbiter, the
+  federated ``/metrics``, and the watchdog alike); this module only
+  TYPES the sample into :class:`RunSignals`. Nothing here instruments
+  a run — the scheduler is a pure reader of artifacts that exist
+  anyway, and it never opens a metrics file itself (regression-pinned
+  by ``tests/test_hub.py``).
 * **Policy** (:meth:`FleetScheduler.decide`) — the pod is
   multi-tenant: each :class:`RunSpec` carries a ``kind`` (``train`` or
   ``serve``) and the policy is deliberately **asymmetric**. Training
@@ -51,6 +55,18 @@ original submission happened to put them. This module is the arbiter:
   change up and rides the proven path (donor: SIGTERM → checkpoint →
   exit 75 → relaunch smaller; recipient: probe → grow-resume). The
   scheduler never signals a training process directly.
+* **Causal tracing** — every decision carries a monotonic
+  ``decision_id`` and a ``cause`` (``serve_breach`` for SLO
+  preemptions, ``serve_release`` for the off-peak reclaim, ``goodput``
+  for stall-market moves). The id is written into the allocation file
+  as trailing metadata tokens (``fleet/capacity.py`` — old readers
+  still parse the leading integer), so the donor's relaunch env, its
+  resume record, its flight-ring slot and its goodput window can all
+  name WHICH arbitration moved them — and the preempt-grant that
+  consumes chips matured out of a donation REUSES the donation's id,
+  so one ``decision_id`` spans the whole
+  donate→SIGTERM→exit-75→relaunch→grant chain (``obs pod`` renders
+  it; ``make tenancy-drill`` asserts it on real processes).
 * **Audit** — every decision appends a ``fleet`` history record
   (schema-additive; ``obs summarize``/``pod`` render it) carrying the
   allocations before/after AND the full signal inputs that justified
@@ -85,20 +101,25 @@ from tpu_dist.elastic.supervisor import (
 from tpu_dist.fleet import capacity as capacity_lib
 from tpu_dist.obs import counters as counters_lib
 from tpu_dist.obs import export as export_lib
+from tpu_dist.obs import hub as hub_lib
+
+# Heartbeat-staleness threshold — re-exported from its ONE home in the
+# hub (obs/hub.py) for the existing importers of
+# ``scheduler.STALE_AFTER_S``.
+from tpu_dist.obs.hub import STALE_AFTER_S  # noqa: F401  (re-export)
 
 #: ``fleet``/``tenancy`` records stamp the CURRENT history schema
-#: (metrics/history.py — v14 after the additive ``tenancy`` kind). Kept
-#: as a literal so this module stays jax-free; ``tests/test_fleet.py``
-#: pins it to the real SCHEMA_VERSION so the two can never drift
-#: silently.
-FLEET_SCHEMA_VERSION = 14
+#: (metrics/history.py — v15 after the additive ``decision_id``/
+#: ``decision_cause`` tracing fields). Kept as a literal so this module
+#: stays jax-free; ``tests/test_fleet.py`` pins it to the real
+#: SCHEMA_VERSION so the two can never drift silently.
+FLEET_SCHEMA_VERSION = 15
 
 #: The run classes the arbiter understands (``RunSpec.kind``).
 RUN_KINDS = ("train", "serve")
 
-#: Heartbeat older than this reads as a dead/wedged run (matches the
-#: ``obs tail`` STALE threshold and the builtin heartbeat_stale rule).
-STALE_AFTER_S = 60.0
+#: The causal tags a decision can carry — WHY the chips moved.
+DECISION_CAUSES = ("serve_breach", "serve_release", "goodput")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -161,53 +182,60 @@ class RunSignals:
         return out
 
 
+def signals_from_sample(sample: dict) -> RunSignals:
+    """Type one hub sample (``obs/hub.py::sample_run`` — or one entry
+    of a :meth:`TelemetryHub.collect` snapshot's ``runs``) into
+    :class:`RunSignals`. The ONE place the arbiter's gauge vocabulary
+    lives — the scheduler never parses an exposition itself."""
+    vals = sample.get("values") or {}
+
+    def gauge(raw: str) -> Optional[float]:
+        return vals.get(export_lib.metric_name(raw))
+
+    return RunSignals(
+        run=sample["run"],
+        data_stall_frac=gauge("train.data_stall_frac"),
+        goodput_frac=gauge("goodput.goodput_frac"),
+        mfu=gauge("train.mfu"),
+        active_alerts=tuple(export_lib.active_labels(vals)),
+        heartbeat_age_s=sample.get("heartbeat_age_s"),
+        alive=sample.get("alive"),
+        epoch=gauge("train.epoch"),
+        queue_depth=gauge("serve.queue_depth"),
+        availability=gauge("serve.availability"),
+        latency_p99_ms=gauge("serve.latency_p99_ms"),
+    )
+
+
 def read_signals(
     run: str,
     metrics_file: str,
     heartbeat_file: Optional[str] = None,
     now: Optional[float] = None,
 ) -> RunSignals:
-    """Scrape one run's last OpenMetrics exposition (and optionally its
-    heartbeat) into :class:`RunSignals`. Pure file reads — an absent or
-    torn exposition degrades to all-None signals, never raises."""
-    vals = export_lib.scrape(textfile=metrics_file) or {}
+    """One run's :class:`RunSignals`, scraped **via the hub's sample
+    primitive** (``obs/hub.py::sample_run`` — the one scrape fan-in; an
+    absent or torn exposition degrades to all-None signals, a stale or
+    garbage heartbeat fails closed to ``alive=False``, never raises).
+    Kept as the per-run convenience entry point; a pod-scale arbiter
+    feeds a whole hub snapshot through :func:`signals_from_hub`
+    instead of calling this N times."""
+    return signals_from_sample(hub_lib.sample_run(
+        run,
+        metrics_file=metrics_file,
+        heartbeat_file=heartbeat_file,
+        now=now,
+    ))
 
-    def gauge(raw: str) -> Optional[float]:
-        return vals.get(export_lib.metric_name(raw))
 
-    alerts = tuple(export_lib.active_labels(vals))
-    age = None
-    alive: Optional[bool] = None
-    if heartbeat_file is not None:
-        from tpu_dist.obs import heartbeat as heartbeat_lib  # stdlib-only
-
-        rec = heartbeat_lib.read(heartbeat_file)
-        if rec is None:
-            alive = False  # absent beat on a run we were told beats
-        else:
-            ts = rec.get("ts")
-            if isinstance(ts, (int, float)) and not isinstance(ts, bool):
-                age = (time.time() if now is None else now) - float(ts)
-                alive = age <= STALE_AFTER_S
-            else:
-                # a beat that parsed but carries no usable timestamp
-                # (garbage payload) is as dead as a stale one — leaving
-                # it ``alive=None`` would keep the run grant-eligible
-                # on evidence that says nothing about liveness
-                alive = False
-    return RunSignals(
-        run=run,
-        data_stall_frac=gauge("train.data_stall_frac"),
-        goodput_frac=gauge("goodput.goodput_frac"),
-        mfu=gauge("train.mfu"),
-        active_alerts=alerts,
-        heartbeat_age_s=round(age, 1) if age is not None else None,
-        alive=alive,
-        epoch=gauge("train.epoch"),
-        queue_depth=gauge("serve.queue_depth"),
-        availability=gauge("serve.availability"),
-        latency_p99_ms=gauge("serve.latency_p99_ms"),
-    )
+def signals_from_hub(snapshot: dict) -> Dict[str, RunSignals]:
+    """Every run's :class:`RunSignals` out of ONE hub aggregation pass
+    (:meth:`TelemetryHub.collect`) — the pod-scale fan-in: one snapshot
+    feeds the whole ``decide`` call instead of N per-run scrapes."""
+    return {
+        run: signals_from_sample(sample)
+        for run, sample in snapshot.get("runs", {}).items()
+    }
 
 
 @dataclasses.dataclass(frozen=True)
@@ -320,6 +348,18 @@ class FleetScheduler:
         self._last_move_dir: Dict[str, str] = {}  # 'donated' | 'received'
         self.decisions = 0
         self.preemptions = 0
+        # causal arbitration tracing: every decision carries a monotonic
+        # decision_id. decide() stays pure — it READS the next id (and
+        # the matured-donation id below); apply() advances the counter.
+        self._next_decision_id = 1
+        self.last_decision_id = 0
+        # the donation currently maturing in the pending pool, and the
+        # matured donation whose chips now sit in the free pool: the
+        # FIRST grant after maturation reuses that id — the grant is the
+        # completion leg of arbitration N, not a new arbitration — so
+        # one decision_id spans donate→SIGTERM→exit-75→relaunch→grant
+        self._pending_decision_id: Optional[int] = None
+        self._matured_decision_id: Optional[int] = None
         # the serve-policy streak state — derived DETERMINISTICALLY from
         # the signal sequence by note_signals (step drives it), so a
         # replay of the recorded inputs reproduces every decision
@@ -546,6 +586,10 @@ class FleetScheduler:
             self.free += self.pending
             self.pending = 0
             self._pending_since = None
+            # the donation's id rides with its chips into the free pool:
+            # the next grant completes that arbitration under the same id
+            self._matured_decision_id = self._pending_decision_id
+            self._pending_decision_id = None
             self._publish_gauges()
 
     def decide(
@@ -693,9 +737,20 @@ class FleetScheduler:
                 if rsig is not None and rsig.data_stall_frac is not None
                 else ""
             )
+        # a grant that consumes chips matured out of a donation is the
+        # COMPLETION of that arbitration: reuse its id (one decision_id
+        # spans the whole donate→…→grant chain); a grant from original
+        # free-pool slack is its own fresh arbitration
+        chained = self._matured_decision_id is not None
         return {
             **self._base_record(tick, signals),
             "action": "grant",
+            "decision_id": (
+                self._matured_decision_id if chained
+                else self._next_decision_id
+            ),
+            "cause": "serve_breach" if preempt else "goodput",
+            "chained": chained,
             "donor": None,
             "recipient": recipient,
             "chips": int(moved),
@@ -754,9 +809,18 @@ class FleetScheduler:
                 )
                 + " — grantable next tick"
             )
+        if preempt:
+            cause = "serve_breach"
+        elif self.specs[donor].kind == "serve":
+            cause = "serve_release"
+        else:
+            cause = "goodput"
         return {
             **self._base_record(tick, signals),
             "action": "donate",
+            "decision_id": self._next_decision_id,
+            "cause": cause,
+            "chained": False,
             "donor": donor,
             "recipient": None,
             "for_run": for_run,
@@ -774,8 +838,13 @@ class FleetScheduler:
 
     def apply(self, decision: dict, tick: int) -> None:
         """Commit one decision: allocations, cooldown/hysteresis state,
-        pending/free pools, gauges, allocation files."""
+        pending/free pools, decision-id bookkeeping, gauges, allocation
+        files (written WITH the decision metadata tokens — the donor's
+        supervisor reads them back into the relaunch env, which is how
+        the id crosses the process boundary)."""
         after = decision["alloc_after"]
+        did = int(decision.get("decision_id") or self._next_decision_id)
+        cause = decision.get("cause")
         for run in self.specs:
             if after[run] != self.alloc[run]:
                 self._last_move_tick[run] = tick
@@ -785,12 +854,20 @@ class FleetScheduler:
                 self.alloc[run] = after[run]
                 if self.fleet_dir:
                     capacity_lib.write_allocation(
-                        self.allocation_path(run), after[run]
+                        self.allocation_path(run), after[run],
+                        decision_id=did, cause=cause,
                     )
         self.free = decision["free_after"]
         if decision.get("action") == "donate":
             self.pending = decision["pending_after"]
             self._pending_since = tick
+            self._pending_decision_id = did
+        elif did == self._matured_decision_id:
+            # the matured donation's completion grant just fired — the
+            # chain is closed, the next grant is a fresh arbitration
+            self._matured_decision_id = None
+        self._next_decision_id = max(self._next_decision_id, did + 1)
+        self.last_decision_id = did
         self.decisions += 1
         counters_lib.inc("fleet.decisions")
         if decision.get("preempt"):
@@ -800,11 +877,14 @@ class FleetScheduler:
 
     def tenancy_record(self, tick: int) -> dict:
         """One per-tick chip-accounting snapshot (``tenancy`` history
-        kind, schema v14): every run's allocation plus the free and
-        pending pools. ``sum(alloc) + free + pending == total_chips``
-        holds at every tick (the pools are conserved by construction),
-        which is what makes :func:`audit_chip_seconds` exact rather
-        than approximate."""
+        kind, schema v15): every run's allocation plus the free and
+        pending pools, stamped with the id of the LAST arbitration that
+        shaped them (``decision_id`` — 0 until the first move; the
+        ``obs pod`` chip-ownership Gantt reads the ticks off these).
+        ``sum(alloc) + free + pending == total_chips`` holds at every
+        tick (the pools are conserved by construction), which is what
+        makes :func:`audit_chip_seconds` exact rather than
+        approximate."""
         return {
             "kind": "tenancy",
             "schema_version": FLEET_SCHEMA_VERSION,
@@ -814,6 +894,7 @@ class FleetScheduler:
             "pending": int(self.pending),
             "total_chips": int(self.total_chips),
             "run_kinds": {r: s.kind for r, s in sorted(self.specs.items())},
+            "decision_id": int(self.last_decision_id),
         }
 
     def step(
@@ -860,6 +941,10 @@ class FleetScheduler:
                 "fleet.preemptions": self.preemptions,
                 "fleet.free_chips": self.free,
                 "fleet.pending_chips": self.pending,
+                # the hub's chip rollups and the pod-level decision
+                # cursor read these two off the scraped ledger
+                "fleet.total_chips": self.total_chips,
+                "fleet.last_decision_id": self.last_decision_id,
             },
             labeled={"fleet_allocation": dict(self.alloc)},
             label_keys={"fleet_allocation": "run"},

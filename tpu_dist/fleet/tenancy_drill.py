@@ -11,14 +11,20 @@ FleetScheduler` in three phases:
 tick clock: every tick writes the RECORDED serve exposition (real
 ``ServeStats`` windows through the real SLO alert engine — the spike
 windows genuinely fire ``slo_*`` rules), genuinely scrapes it back
-through ``read_signals``, and steps the scheduler. Asserted exactly:
-the preempt-donate fires at ``spike_tick + serve_breach_ticks - 1``,
-the chips land one tick later (the documented preemption-latency
-bound), availability recovers over threshold, the off-peak release +
-grow-back land at their tick-arithmetic positions, and the chip-second
-conservation identity holds **exactly** (``audit_chip_seconds`` over
-the per-tick ``tenancy`` snapshots: per-run bucket sums ∪ free ∪
-pending == pod chip-seconds, integer chip-ticks, no float slack).
+through the pod telemetry hub (ONE :class:`~tpu_dist.obs.hub.
+TelemetryHub` aggregation pass fed to ``signals_from_hub`` — the same
+single fan-in a production arbiter uses), and steps the scheduler.
+Asserted exactly: the preempt-donate fires at
+``spike_tick + serve_breach_ticks - 1``, the chips land one tick later
+(the documented preemption-latency bound), the donate and its
+completion grant share ONE ``decision_id`` (``chained``), availability
+recovers over threshold, the off-peak release + grow-back land at
+their tick-arithmetic positions, the federated hub page round-trips
+with per-run labels and the ``pod.last_decision_id`` rollup, and the
+chip-second conservation identity holds **exactly**
+(``audit_chip_seconds`` over the per-tick ``tenancy`` snapshots:
+per-run bucket sums ∪ free ∪ pending == pod chip-seconds, integer
+chip-ticks, no float slack).
 
 **Phase cycle** (slow) — the same day against a REAL trainer: a golden
 uninterrupted run first, then the co-scheduled run driven by the real
@@ -30,7 +36,16 @@ off-peak the two-phase donate/grant reclaims the chips (allocation
 grows → probe → checkpoint → relaunch at full size). Verified: a
 shrink AND a grow resume record, every epoch's loss within the golden
 trajectory tolerance, the scraped availability back over threshold,
-the wall-clock SIGTERM latency, and the exact conservation identity.
+the wall-clock SIGTERM latency, and the exact conservation identity —
+plus the full causal chain (``make hub-drill`` surface): the
+preempt-donate's ``decision_id`` must reappear, verbatim, in the
+scheduler's ``fleet`` ledger records, the allocation file's metadata
+tokens (stamped into the relaunch env by ``stamp_decision_env``), the
+shrunken trainer's ``resume`` record (with ``decision_cause ==
+"serve_breach"``), its per-round flight ring, and the hub's federated
+``pod.last_decision_id`` rollup, with the serve-preempt gap charged to
+the ``preempt_for_serve_s`` goodput bucket and the bucket partition
+still summing to wall-clock exactly.
 
 **Phase replica** (slow) — the serving half of robustness: a real
 supervised replica process is SIGKILL'd mid-serve; the
@@ -58,9 +73,10 @@ from tpu_dist.fleet.scheduler import (
     FleetScheduler,
     RunSpec,
     audit_chip_seconds,
-    read_signals,
+    signals_from_hub,
 )
 from tpu_dist.obs import export as export_lib
+from tpu_dist.obs import hub as hub_lib
 
 #: The recorded diurnal day the policy phase replays, one profile per
 #: scheduler tick. With the default policy (serve_breach_ticks=2,
@@ -210,8 +226,19 @@ def run_policy_phase(args) -> int:
     slo_engine = slo_lib.make_slo_engine(slo_lib.load_slo_rules("default"))
     svc_prom = os.path.join(fleet_dir, "svc", "metrics.prom")
     trainer_prom = os.path.join(fleet_dir, "trainer", "metrics.prom")
+    fleet_prom = os.path.join(fleet_dir, "fleet.prom")
     os.makedirs(os.path.dirname(svc_prom), exist_ok=True)
     _write_trainer_exposition(trainer_prom)
+    # the ONE scrape fan-in: the arbiter reads every signal off one hub
+    # aggregation pass — exactly the production shape (obs/hub.py)
+    hub = hub_lib.TelemetryHub(
+        [
+            hub_lib.RunSource("trainer", metrics_file=trainer_prom,
+                              kind="train"),
+            hub_lib.RunSource("svc", metrics_file=svc_prom, kind="serve"),
+        ],
+        fleet_exposition=fleet_prom,
+    )
 
     by_tick: dict = {}
     spike_k = 0
@@ -222,12 +249,11 @@ def run_policy_phase(args) -> int:
         )
         if profile == "spike":
             spike_k += 1
-        sig = {
-            "trainer": read_signals("trainer", trainer_prom),
-            "svc": read_signals("svc", svc_prom),
-        }
+        sched.write_exposition(fleet_prom)
+        sig = signals_from_hub(hub.collect())
         if sig["svc"].queue_depth != window["serve.queue_depth"]:
-            _say(f"FAIL: tick {tick}: scrape did not round-trip the queue")
+            _say(f"FAIL: tick {tick}: hub scrape did not round-trip "
+                 "the queue")
             return 1
         for d in sched.step(tick, sig, ts=tick * TICK_SECONDS):
             by_tick[tick] = d
@@ -265,6 +291,15 @@ def run_policy_phase(args) -> int:
          sched.alloc["trainer"] == args.devices),
         ("both preemption moves counted",
          sched.preemptions == 2),
+        # causal tracing (schema v15): the donation and the grant that
+        # consumes its matured chips are ONE arbitration under one id
+        ("donate and its completion grant share ONE decision_id",
+         by_tick.get(donate_tick, {}).get("decision_id") is not None
+         and by_tick[donate_tick].get("decision_id")
+         == by_tick.get(grant_tick, {}).get("decision_id")
+         and by_tick[grant_tick].get("chained") is True),
+        ("the hub aggregated every tick with zero drops",
+         hub.drops_total == {"torn": 0, "dead": 0, "absent": 0}),
     )
     ok = True
     for what, passed in checks:
@@ -278,6 +313,21 @@ def run_policy_phase(args) -> int:
         f"(= spike tick {SPIKE_TICK} + serve_breach_ticks "
         f"{policy.serve_breach_ticks} - 1), chips landed at tick "
         f"{grant_tick}"
+    )
+    sched.write_exposition(fleet_prom)
+    page = hub.federated()
+    if not (
+        page.endswith("# EOF\n")
+        and 'run="svc"' in page
+        and "tpu_dist_pod_last_decision_id" in page
+        and "tpu_dist_pod_runs_aggregated 2" in page
+    ):
+        _say("FAIL: the federated hub page lost its per-run labels or "
+             "pod rollups")
+        return 1
+    _say(
+        "hub: federated page carries per-run labels + pod rollups "
+        f"(last decision #{sched.last_decision_id})"
     )
     if not _report_conservation(_load(sched.history_path())):
         return 1
@@ -322,8 +372,20 @@ class _DiurnalDriver:
         self.trainer_prom = os.path.join(
             sched.fleet_dir, "trainer", "metrics.prom"
         )
+        self.fleet_prom = os.path.join(sched.fleet_dir, "fleet.prom")
         os.makedirs(os.path.dirname(self.svc_prom), exist_ok=True)
         _write_trainer_exposition(self.trainer_prom)
+        # the cycle phase arbitrates off the SAME single hub fan-in the
+        # policy phase proved — no drill-private scrape path
+        self.hub = hub_lib.TelemetryHub(
+            [
+                hub_lib.RunSource("trainer", metrics_file=self.trainer_prom,
+                                  kind="train"),
+                hub_lib.RunSource("svc", metrics_file=self.svc_prom,
+                                  kind="serve"),
+            ],
+            fleet_exposition=self.fleet_prom,
+        )
 
     def _log(self) -> List[dict]:
         try:
@@ -372,15 +434,13 @@ class _DiurnalDriver:
         if profile == "spike" and self.spike_tick is None:
             self.spike_tick = self.tick
             _say(f"tick {self.tick}: the recorded load spike begins")
-        window = _write_serve_exposition(
+        _write_serve_exposition(
             self.svc_prom, self.slo_engine, profile, self.spike_k
         )
         if profile == "spike":
             self.spike_k += 1
-        sig = {
-            "trainer": read_signals("trainer", self.trainer_prom),
-            "svc": read_signals("svc", self.svc_prom),
-        }
+        self.sched.write_exposition(self.fleet_prom)
+        sig = signals_from_hub(self.hub.collect())
         for d in self.sched.step(self.tick, sig, ts=time.time()):
             self.decisions.append(d)
             _say(f"tick {self.tick}: {d['action']}"
@@ -406,6 +466,7 @@ def run_cycle_phase(args) -> int:
     from tpu_dist.elastic.supervisor import (
         CapacityProbe,
         RoundResult,
+        stamp_decision_env,
         supervise,
     )
     from tpu_dist.fleet import capacity as capacity_lib
@@ -445,14 +506,25 @@ def run_cycle_phase(args) -> int:
     elastic_ck = os.path.join(args.workdir, "ck_elastic")
     preempt_latency = [None]
 
+    crash_base = os.path.join(args.workdir, "crash")
+
     def round_fn(n: int, round_idx: int) -> RoundResult:
         child = [sys.executable, "-m", "tpu_dist.cli.train"] + base + [
             "--ckpt_dir", elastic_ck, "--log_file", elastic_log,
+            # one flight-ring dir per ROUND: the chain check reads the
+            # shrunken incarnation's ring after later rounds re-arm
+            "--crash_dir", os.path.join(crash_base, f"round{round_idx}"),
         ]
         if round_idx:
             child += ["--resume"]
         env = _train_env(n)
         env["TPU_DIST_ELASTIC_RESTARTS"] = str(round_idx)
+        # propagate the active arbitration into the relaunch env — the
+        # trainer stamps it into its resume record and flight ring
+        meta = stamp_decision_env(env, sched.allocation_path("trainer"))
+        if meta["decision_id"] is not None:
+            _say(f"round {round_idx}: relaunch actuates fleet decision "
+                 f"#{meta['decision_id']} ({meta['cause']})")
         _say(f"round {round_idx}: trainer at {n} device(s)")
         proc = subprocess.Popen(child, env=env)
         probe.reset_timer()
@@ -555,6 +627,77 @@ def run_cycle_phase(args) -> int:
             return 1
     if not _report_conservation(_load(sched.history_path())):
         return 1
+
+    # -- the full causal chain (make hub-drill): ONE decision_id spans
+    # scheduler ledger -> allocation file/relaunch env -> resume record
+    # -> donor flight ring -> hub exposition, and the goodput ledger
+    # charges the serve-preempt gap to its own bucket, partition exact
+    from tpu_dist.obs import flight as flight_lib
+    from tpu_dist.obs import goodput as goodput_lib
+
+    donates = [
+        d for d in driver.decisions
+        if d.get("preempt") and d["action"] == "donate"
+    ]
+    did = donates[0].get("decision_id") if donates else None
+    ledger_ids = {
+        r.get("decision_id")
+        for r in _load(sched.history_path())
+        if r.get("kind") == "fleet"
+    }
+    shrink = shrinks[0] if shrinks else {}
+    ring_resumes: List[dict] = []
+    try:
+        ring = flight_lib.decode(os.path.join(
+            crash_base, f"round{shrink.get('restarts')}",
+            flight_lib.RING_NAME,
+        ))
+        ring_resumes = [
+            r for r in ring["records"]
+            if r.get("kind") == "resume" and r.get("decision_id") == did
+        ]
+    except OSError as e:
+        # Tolerated: the "flight ring stamped it" chain check below fails
+        # loudly on an empty ring_resumes, naming the missing link.
+        _say(f"note: donor flight ring unreadable ({e!r})")
+    sched.write_exposition(driver.fleet_prom)
+    rollup = driver.hub.collect()["rollup"]
+    gp = goodput_lib.run_ledger(recs) or {}
+    bucket_sum = sum(
+        gp.get(f"{b}_s", 0.0) for b in goodput_lib.ALL_BUCKETS
+    )
+    chain_checks = (
+        ("the preempt-donate carried a decision_id",
+         isinstance(did, int)),
+        ("the scheduler ledger stamped it", did in ledger_ids),
+        ("the shrink resume record propagated it",
+         shrink.get("decision_id") == did
+         and shrink.get("decision_cause") == "serve_breach"),
+        ("the donor's flight ring stamped it", bool(ring_resumes)),
+        ("the hub exposition rolled it up",
+         isinstance(rollup.get("last_decision_id"), float)
+         and rollup["last_decision_id"] >= (did or 1)),
+        ("the serve-preempt gap landed in preempt_for_serve_s",
+         gp.get("preempt_for_serve_s", 0.0) > 0.0),
+        # run_ledger rounds each bucket (and elapsed) to 4 decimals for
+        # rendering — the unrounded partition is exact, so the rounded
+        # sum can drift by at most 5e-5 per term (10 terms => 5e-4)
+        ("the goodput bucket partition stayed exact",
+         abs(bucket_sum - gp.get("elapsed_s", -1.0)) < 1e-3),
+    )
+    ok = True
+    for what, passed in chain_checks:
+        if not passed:
+            _say(f"FAIL: {what}")
+            ok = False
+    if not ok:
+        return 1
+    _say(
+        f"causal chain: decision #{did} spans scheduler ledger -> "
+        "relaunch env -> resume record -> donor flight ring -> hub "
+        f"exposition; preempt_for_serve_s={gp['preempt_for_serve_s']:.1f}s "
+        "with the bucket partition exact"
+    )
     _say(
         "PASS cycle: spike preempted the trainer losslessly, serving "
         "recovered, off-peak reclaimed the chips, books balanced"
@@ -699,20 +842,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--tick_s", type=float, default=0.25,
                    help="cycle phase: wall seconds per scheduler tick")
     p.add_argument(
-        "--phase", choices=("all", "policy", "cycle", "replica"),
+        "--phase", choices=("all", "policy", "cycle", "replica", "hub"),
         default="all",
         help="'policy' = the recorded diurnal replay (pure, fast); "
              "'cycle' = the same day against a real trainer (jax "
              "subprocesses, slow); 'replica' = SIGKILL a supervised "
-             "serving replica (jax subprocess); 'all' = every phase",
+             "serving replica (jax subprocess); 'hub' = policy + cycle "
+             "(the make hub-drill surface: the hub fan-in and the full "
+             "decision_id chain); 'all' = every phase",
     )
     args = p.parse_args(argv)
     os.makedirs(args.workdir, exist_ok=True)
-    if args.phase in ("all", "policy"):
+    if args.phase in ("all", "policy", "hub"):
         rc = run_policy_phase(args)
         if rc != 0:
             return rc
-    if args.phase in ("all", "cycle"):
+    if args.phase in ("all", "cycle", "hub"):
         rc = run_cycle_phase(args)
         if rc != 0:
             return rc

@@ -78,7 +78,14 @@ import jax
 
 from tpu_dist.obs import counters as counters_lib
 
-SCHEMA_VERSION = 14  # v14 (additive): 'tenancy' records — the fleet
+SCHEMA_VERSION = 15  # v15 (additive): causal arbitration tracing —
+#                      'resume'/'fleet'/'tenancy' records carry the
+#                      scheduler's monotonic decision_id (+
+#                      decision_cause on resumes), and the goodput
+#                      ledger splits preempt_for_serve_s out of
+#                      recovery_s off that cause
+#                      (tpu_dist/fleet/scheduler.py, obs/goodput.py);
+#                      v14 added 'tenancy' records — the fleet
 #                      scheduler's per-tick chip-accounting snapshots
 #                      (alloc/free/pending; tpu_dist/fleet/scheduler.py)
 #                      whose sums make chip-second conservation exact;

@@ -31,6 +31,18 @@ Subcommands::
         lower latency is never flagged). Exits 1 on any regression
         beyond the threshold — wire it into CI.
 
+    hub --run name=metrics.prom[,hb=hb.json][,port=P][,kind=serve] ...
+        [--fleet fleet.prom] [--out federated.prom] [--port P]
+        [--interval S] [--once]
+        Pod telemetry hub (``obs/hub.py``): pull-aggregate every run's
+        OpenMetrics exposition into ONE federated exposition with
+        per-run labels plus pod rollups (chips from the capacity
+        ledger, per-class goodput, worst stall, breach count, last
+        arbitration decision id), torn/stale/dead-run tolerant with
+        counted drops.  ``--once`` scrapes once and prints (or writes
+        ``--out``); otherwise loops at ``--interval``, publishing to
+        the textfile and/or an HTTP ``/metrics`` on ``--port``.
+
     pod <host0.jsonl> <host1.jsonl> ... [--heartbeat hb.json ...]
         [--trace-out pod_trace.json] [--format text|json]
         Cross-host aggregation: per-host goodput ledgers side by side,
@@ -149,6 +161,34 @@ def main(argv=None) -> int:
              "flagged; two serve-less logs compare nothing → exit 2",
     )
     c.add_argument("--format", choices=("text", "json"), default="text")
+    hb = sub.add_parser(
+        "hub",
+        help="pod telemetry hub: federate every run's exposition into "
+             "one /metrics with per-run labels + pod rollups",
+    )
+    hb.add_argument(
+        "--run", action="append", default=[], metavar="SPEC", dest="runs",
+        help="one run source: name=metrics_path[,hb=heartbeat][,port=P]"
+             "[,kind=train|serve] (or name=port:P for HTTP-only); "
+             "repeatable — the hub needs at least one",
+    )
+    hb.add_argument(
+        "--fleet", default=None, metavar="FILE",
+        help="the fleet scheduler's exposition (write_exposition) — the "
+             "capacity ledger the chip/decision rollups come from",
+    )
+    hb.add_argument("--out", default=None, metavar="FILE",
+                    help="publish the federated exposition to this "
+                         "textfile (atomic tmp+replace)")
+    hb.add_argument("--port", type=int, default=None, metavar="P",
+                    help="also serve GET /metrics on this port")
+    hb.add_argument("--interval", type=float, default=5.0, metavar="S",
+                    help="scrape/publish interval (default 5s)")
+    hb.add_argument("--once", action="store_true",
+                    help="one aggregation pass, print (or --out), exit")
+    hb.add_argument("--stale-after", type=float, default=None, metavar="S",
+                    help="heartbeat age beyond which a run reads dead "
+                         "(default: hub.STALE_AFTER_S)")
     pd = sub.add_parser(
         "pod",
         help="merge per-host logs into one cross-host report / timeline",
@@ -346,6 +386,55 @@ def main(argv=None) -> int:
         else:
             print(compare_lib.format_bench_report(report))
         return 0
+
+    if args.cmd == "hub":
+        from tpu_dist.obs import hub as hub_lib
+
+        if not args.runs:
+            print("tpu_dist.obs: hub needs at least one --run "
+                  "name=metrics_path[,hb=...,port=...,kind=...]",
+                  file=sys.stderr)
+            return 2
+        try:
+            sources = [hub_lib.parse_source(s) for s in args.runs]
+            h = hub_lib.TelemetryHub(
+                sources,
+                fleet_exposition=args.fleet,
+                **({"stale_after_s": args.stale_after}
+                   if args.stale_after is not None else {}),
+            )
+        except ValueError as e:
+            print(f"tpu_dist.obs: {e}", file=sys.stderr)
+            return 2
+        if args.once:
+            snap = h.collect()
+            text = h.federated(snap)
+            if args.out:
+                h.write(args.out, snap)
+                print(f"federated {snap['rollup']['runs_aggregated']} "
+                      f"run(s) to {args.out}")
+            else:
+                print(text, end="")
+            return 0 if snap["rollup"]["runs_aggregated"] else 1
+        server = hub_lib.HubServer(args.port) if args.port else None
+        if server is not None:
+            print(f"hub serving /metrics on :{server.port}")
+        try:
+            import time as _time
+
+            while True:
+                snap = h.collect()
+                text = h.federated(snap)
+                if args.out:
+                    h.write(args.out, snap)
+                if server is not None:
+                    server.publish(text)
+                _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            if server is not None:
+                server.close()
 
     if args.cmd == "pod":
         from tpu_dist.obs import aggregate as aggregate_lib

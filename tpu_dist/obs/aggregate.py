@@ -21,7 +21,18 @@ merges per-host artifacts into one view:
   ``ts - rel_s`` of its first record, and every host is shifted by its
   offset from the earliest origin, so skew between hosts renders as real
   horizontal displacement instead of every track pretending to start at
-  zero.
+  zero. When any log carries ``tenancy`` snapshots (the fleet
+  scheduler's per-tick chip accounting, schema v14+), one extra
+  **chip-ownership Gantt track** is synthesized beside the host
+  tracks: one row per chip, one bar per ownership stretch (run name /
+  ``free`` / ``pending``), each bar stamped with the ``decision_id``
+  that created it — the pod's chip timeline at a glance.
+* **Decision chains** (:func:`decision_chains`): every artifact
+  carrying the same ``decision_id`` (schema v15 causal tracing) —
+  the scheduler's donate and its completion grant, the tenancy ticks
+  it shaped, the donor's/recipient's relaunch ``resume`` records —
+  folded into one end-to-end chain, so "why did run A shrink at tick
+  42" is one rendered line, not a four-file join.
 
 Per-host logs come from ``--per_host_log`` (each process writes
 ``<log_file>.h<rank>``; rank 0 keeps the bare path) or from any N
@@ -130,6 +141,49 @@ def heartbeat_rows(
     return out
 
 
+def decision_chains(hosts: List[dict]) -> List[dict]:
+    """Fold every artifact stamped with the same ``decision_id`` (schema
+    v15) into one causal chain: the scheduler's chip moves (a donate and
+    its completion grant SHARE the id) joined with the relaunch
+    ``resume`` records the decision caused on donor and recipient.
+    ``hosts`` is the per-host dict list :func:`pod_report` builds. A
+    chain with moves but no observed resume is surfaced as incomplete —
+    that is exactly the "decision fired but nobody relaunched" bug the
+    tracing exists to catch, so it must not be dropped."""
+    chains: dict = {}
+
+    def chain(did: int, cause: Optional[str]) -> dict:
+        c = chains.setdefault(did, {
+            "decision_id": did, "cause": None, "moves": [], "resumes": [],
+        })
+        if cause and not c["cause"]:
+            c["cause"] = cause
+        return c
+
+    for h in hosts:
+        for fd in h.get("fleet_decisions", []):
+            did = fd.get("decision_id")
+            if did is None:
+                continue
+            chain(did, fd.get("cause"))["moves"].append(
+                {"host": h["host"], **fd}
+            )
+        for rs in h.get("resumes", []):
+            did = rs.get("decision_id")
+            if did is None:
+                continue
+            chain(did, rs.get("decision_cause"))["resumes"].append(
+                {"host": h["host"], **rs}
+            )
+    out = []
+    for did in sorted(chains):
+        c = chains[did]
+        c["moves"].sort(key=lambda m: (m.get("tick") or 0))
+        c["complete"] = bool(c["moves"]) and bool(c["resumes"])
+        out.append(c)
+    return out
+
+
 def pod_report(
     host_records: List[Tuple[str, List[dict]]],
     heartbeats: Optional[List[str]] = None,
@@ -158,6 +212,9 @@ def pod_report(
             "world_sizes": rep.get("world_sizes", []),
             # fleet-scheduler chip moves (schema v8) found in this log
             "fleet_decisions": rep.get("fleet_decisions", []),
+            # per-tick chip accounting (schema v14) — the chip-ownership
+            # Gantt's raw material
+            "tenancy_snapshots": rep.get("tenancy_snapshots", []),
             # crash bundles (schema v9): how this host's run DIED
             "postmortems": rep.get("postmortems", []),
             # serving SLO windows (schema v10): this host's serving
@@ -188,6 +245,7 @@ def pod_report(
     return {
         "n_hosts": len(hosts),
         "hosts": hosts,
+        "decision_chains": decision_chains(hosts),
         "epoch_skew": epoch_skew_rows(reports),
         "heartbeats": heartbeat_rows(heartbeats) if heartbeats else [],
         "pod": {
@@ -207,11 +265,95 @@ def pod_report(
     }
 
 
+def _chip_ownership_events(
+    host_records: List[Tuple[str, List[dict]]],
+    base: Optional[float],
+    pid: int,
+) -> List[dict]:
+    """The per-chip ownership Gantt track, synthesized from the raw
+    ``tenancy`` snapshots found in any host's log: one ``tid`` row per
+    chip, one ``X`` bar per ownership stretch (a run's name, ``free``,
+    or ``pending``), each bar stamped with the ``decision_id`` active at
+    the tick that started it. Chips inside a tick are laid out
+    deterministically — runs in name order, then free, then pending —
+    so the SAME layout renders on every machine; a bar ends where the
+    next tick's layout disagrees, and the last tick extends by the
+    median tick interval so it is visible at all."""
+    snaps: dict = {}
+    for _, records in host_records:
+        for rec in records:
+            # dedup by tick — the same scheduler tick may be mirrored
+            # into several hosts' logs
+            if rec.get("kind") != "tenancy" or rec.get("tick") is None:
+                continue
+            snaps.setdefault(rec["tick"], rec)
+    if not snaps:
+        return []
+    ordered = [snaps[t] for t in sorted(snaps)]
+    times = [
+        float(rec["ts"]) if isinstance(rec.get("ts"), (int, float)) else None
+        for rec in ordered
+    ]
+    if any(t is None for t in times):
+        # no wall clock on the snapshots (foreign tooling): render ticks
+        # as seconds so the track still has shape
+        ref = 0.0 if base is None else base
+        times = [ref + float(rec.get("tick", i)) for i, rec in enumerate(ordered)]
+    ref = min(times) if base is None else base
+    deltas = sorted(b - a for a, b in zip(times, times[1:]) if b > a)
+    tail = median(deltas) if deltas else 1.0
+    total = max(int(rec.get("total_chips") or 0) for rec in ordered)
+    if total <= 0:
+        return []
+
+    def layout(rec: dict) -> List[str]:
+        lane: List[str] = []
+        alloc = rec.get("alloc") or {}
+        for run in sorted(alloc):
+            lane += [run] * int(alloc[run])
+        lane += ["free"] * int(rec.get("free") or 0)
+        lane += ["pending"] * int(rec.get("pending") or 0)
+        return (lane + ["?"] * total)[:total]
+
+    layouts = [layout(rec) for rec in ordered]
+    events: List[dict] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": "chip ownership (tenancy)"},
+    }]
+    for chip in range(total):
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": chip,
+            "args": {"name": f"chip {chip}"},
+        })
+    n = len(ordered)
+    for chip in range(total):
+        i = 0
+        while i < n:
+            j = i
+            while j + 1 < n and layouts[j + 1][chip] == layouts[i][chip]:
+                j += 1
+            t0 = times[i]
+            t1 = times[j + 1] if j + 1 < n else times[j] + tail
+            args = {"tick": ordered[i].get("tick")}
+            if ordered[i].get("decision_id") is not None:
+                args["decision_id"] = ordered[i]["decision_id"]
+            events.append({
+                "name": layouts[i][chip], "ph": "X", "cat": "tenancy",
+                "pid": pid, "tid": chip,
+                "ts": round((t0 - ref) * 1e6, 1),
+                "dur": round(max(t1 - t0, 0.0) * 1e6, 1),
+                "args": args,
+            })
+            i = j + 1
+    return events
+
+
 def pod_trace(host_records: List[Tuple[str, List[dict]]]) -> dict:
     """One Perfetto timeline with a track per host. Host i's events keep
     their own layout but move to ``pid=i``; tracks are aligned on the
     shared wall clock via each host's recovered origin so cross-host
-    skew is visible as displacement."""
+    skew is visible as displacement. A final synthetic track renders the
+    per-chip ownership Gantt whenever tenancy snapshots exist."""
     events: List[dict] = []
     origins = [
         _wall_origin(records) for _, records in host_records
@@ -230,6 +372,11 @@ def pod_trace(host_records: List[Tuple[str, List[dict]]]) -> dict:
                 "pid": i,
                 "ts": round(float(e.get("ts", 0.0)) + offset_us, 1),
             })
+    events.extend(_chip_ownership_events(
+        host_records,
+        base if known else None,
+        pid=len(host_records),
+    ))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -286,6 +433,30 @@ def format_text(report: dict) -> str:
                 + goodput_lib.fleet_move_phrase(fd)
                 + (f" — {fd['reason']}" if fd.get("reason") else "")
             )
+    # causal chains (schema v15): one line per decision_id answering
+    # "why did run A shrink at tick N" end to end — the chip moves the
+    # scheduler made under that id, then the relaunches it caused. The
+    # per-move phrase drops its own [decision #N] suffix: the chain
+    # header already names it.
+    for c in report.get("decision_chains", []):
+        steps = []
+        for m in c.get("moves", []):
+            steps.append(
+                f"tick {m.get('tick')} {m.get('action')}: "
+                + goodput_lib.fleet_move_phrase({**m, "decision_id": None})
+            )
+        for rs in c.get("resumes", []):
+            step = f"{rs['host']} resumed dp={rs.get('dp')}"
+            if rs.get("restarts") is not None:
+                step += f" (restart #{rs['restarts']})"
+            steps.append(step)
+        lines.append(
+            f"decision #{c['decision_id']}"
+            + (f" [{c['cause']}]" if c.get("cause") else "")
+            + (": " + " -> ".join(steps) if steps else "")
+            + ("" if c.get("complete")
+               else "  <-- no resume observed: chain INCOMPLETE")
+        )
     # crash forensics (schema v9): a postmortem bundle in a host's log
     # means that run DIED hard — the pod view must lead with who crashed
     # and where it was stuck, not bury it under throughput rows

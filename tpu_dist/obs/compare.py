@@ -83,6 +83,14 @@ METRIC_DIRECTIONS: dict = {
     # blocking again. Absolute slack of 0.25 s: restore ladders and
     # first-save directory creation wobble tenths of a second run to run.
     "ckpt_s": ("lower", 0.25),
+    # the co-scheduling layer's gating scalar (goodput ledger bucket,
+    # schema v15; obs/goodput.py): total wall-clock seconds this run
+    # spent relaunching because the fleet arbiter preempted it for a
+    # breached serving SLO (world-change gaps whose resume carried a
+    # propagated decision_id with cause serve_breach). HIGHER is a
+    # regression — the policy started paying more training time for the
+    # same SLO. Absolute slack of 0.25 s, the relaunch-wobble floor.
+    "preempt_for_serve_s": ("lower", 0.25),
     # bench-mode per-record fields
     "value": ("higher", 0.0),          # images/sec (or tokens/sec)
     "sec_per_epoch": ("lower", 0.0),
@@ -151,12 +159,18 @@ REPORT_METRICS: Tuple[Tuple[str, str, float], ...] = _table((
     "step_time_p99_s", "data_stall_frac", "mfu_mean", "final_loss",
     "final_val_top1", "goodput_frac", "overlap_frac", "collective_frac",
     "peak_hbm_bytes", "planner_error_frac", "ckpt_s",
+    "preempt_for_serve_s",
 ))
 
 #: the ``--goodput`` gate's metric set: time-to-useful-work only. The
 #: fraction is the headline; the stall fraction rides along because a
-#: goodput regression's most common cause is an input-pipeline change.
-GOODPUT_METRICS: Tuple[str, ...] = ("goodput_frac", "data_stall_frac")
+#: goodput regression's most common cause is an input-pipeline change,
+#: and the serve-preemption seconds because a co-scheduling policy that
+#: started charging training more for the same SLO is a goodput story
+#: even when the fraction hides it in a long run.
+GOODPUT_METRICS: Tuple[str, ...] = (
+    "goodput_frac", "data_stall_frac", "preempt_for_serve_s",
+)
 
 #: the ``--slo`` gate's metric set (serving runs, ``serve`` records):
 #: request rate, latency ceilings (upper-bound quantiles in ms),
@@ -251,6 +265,10 @@ def report_scalars(report: dict) -> dict:
         # 'ckpt' bucket); None — skipped, never faked — on a ledger-less
         # log. Gates the two-phase save's whole point: hiding the write.
         "ckpt_s": gp.get("ckpt_s"),
+        # the co-scheduling layer's chosen cost (goodput ledger
+        # 'preempt_for_serve' bucket, schema v15); None — skipped,
+        # never faked — on a ledger-less log
+        "preempt_for_serve_s": gp.get("preempt_for_serve_s"),
     }
 
 

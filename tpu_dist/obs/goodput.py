@@ -23,7 +23,13 @@ Buckets (field ``<name>_s`` in every record):
 * ``preempt`` — preemption/restart loss: the SIGTERM-to-exit tail in the
   dying process plus (offline) the wall-clock gap between a segment's
   last record and the resumed segment's construction,
-* ``recovery`` — divergence auto-recovery (restore + LR backoff),
+* ``preempt_for_serve`` — the fleet arbiter took this run's chips for a
+  breached serving SLO: a world-change gap whose resume record carries
+  a propagated ``decision_id`` with cause ``serve_breach`` (schema
+  v15). Split out of ``recovery`` so "we chose to pay this for the
+  SLO" and "elastic kept us alive" are budgeted separately,
+* ``recovery`` — divergence auto-recovery (restore + LR backoff), plus
+  (offline) the relaunch gap of any OTHER elastic resize,
 * ``unattributed`` — whatever remains; never hidden, so a growing
   remainder is itself a finding.
 
@@ -54,7 +60,7 @@ from typing import Dict, List, Optional, Tuple
 #: (window minus the rest), never written to directly.
 BUCKETS: Tuple[str, ...] = (
     "productive", "compile", "ckpt", "data_stall", "eval",
-    "preempt", "recovery",
+    "preempt", "preempt_for_serve", "recovery",
 )
 ALL_BUCKETS: Tuple[str, ...] = BUCKETS + ("unattributed",)
 
@@ -178,6 +184,11 @@ def fleet_move_phrase(rec: dict) -> str:
         # an SLO-breach preemption (multi-tenant pod): the move was
         # demanded by a serving breach, not offered by a stalled donor
         phrase += " [SLO preemption]"
+    if rec.get("decision_id") is not None:
+        # causal arbitration tracing (schema v15): every renderer names
+        # the arbitration, so a donate and its completion grant read as
+        # one chain at a glance
+        phrase += f" [decision #{rec['decision_id']}]"
     return phrase
 
 
@@ -209,9 +220,17 @@ def run_ledger(records: List[dict]) -> Optional[dict]:
     lengths happen to agree, and a voluntary resize must never inflate
     ``preempt_s``). That gap is the reshard/resize+relaunch cost of
     keeping the run alive at a new world size and is charged to
-    ``recovery_s`` instead (docs/resilience.md "Elastic training" /
-    "Scale-up & fleet scheduling"). Returns None when the log holds no
-    goodput records (an old-schema log)."""
+    ``recovery_s`` — UNLESS the resume carries a propagated
+    ``decision_id`` with ``decision_cause == "serve_breach"`` (schema
+    v15: the fleet arbiter preempted this run for a breached serving
+    SLO), in which case it is charged to ``preempt_for_serve_s``: the
+    pod CHOSE to pay that gap for the SLO, and budgeting it as generic
+    elastic recovery would hide the cost of the co-scheduling policy
+    (docs/resilience.md "Elastic training" / "Scale-up & fleet
+    scheduling"). The partition invariant is untouched: all three gap
+    accumulators land in ``restart_gap_s`` and ``elapsed_s``, so the
+    buckets still sum to wall-clock exactly. Returns None when the log
+    holds no goodput records (an old-schema log)."""
     totals = _zero_totals()
     n_segments = 0
     saw_goodput = False
@@ -222,6 +241,7 @@ def run_ledger(records: List[dict]) -> Optional[dict]:
     last_ts: Optional[float] = None
     restart_s = 0.0
     reshard_gap_s = 0.0
+    serve_gap_s = 0.0
 
     def fold_segment():
         nonlocal seg_final, seg_windows, seg_has_window
@@ -262,7 +282,18 @@ def run_ledger(records: List[dict]) -> Optional[dict]:
                     rec.get("kind") == "resume"
                     and resume_direction(rec) is not None
                 ):
-                    reshard_gap_s += gap
+                    if (
+                        rec.get("decision_cause") == "serve_breach"
+                        and rec.get("decision_id") is not None
+                    ):
+                        # the fleet arbiter took the chips for a
+                        # breached serving SLO (the relaunch env
+                        # propagated its decision_id here) — this gap
+                        # is the chosen cost of the co-scheduling
+                        # policy, not generic elastic recovery
+                        serve_gap_s += gap
+                    else:
+                        reshard_gap_s += gap
                 else:
                     restart_s += gap
             cur_run = rid
@@ -283,10 +314,15 @@ def run_ledger(records: List[dict]) -> Optional[dict]:
     if not saw_goodput:
         return None
     totals["preempt_s"] = round(totals["preempt_s"] + restart_s, 4)
+    totals["preempt_for_serve_s"] = round(
+        totals["preempt_for_serve_s"] + serve_gap_s, 4
+    )
     totals["recovery_s"] = round(totals["recovery_s"] + reshard_gap_s, 4)
-    totals["restart_gap_s"] = round(restart_s + reshard_gap_s, 4)
+    totals["restart_gap_s"] = round(
+        restart_s + reshard_gap_s + serve_gap_s, 4
+    )
     totals["elapsed_s"] = round(
-        totals["elapsed_s"] + restart_s + reshard_gap_s, 4
+        totals["elapsed_s"] + restart_s + reshard_gap_s + serve_gap_s, 4
     )
     for b in ALL_BUCKETS:
         totals[f"{b}_s"] = round(totals[f"{b}_s"], 4)

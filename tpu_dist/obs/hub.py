@@ -1,0 +1,453 @@
+"""The pod telemetry hub — ONE federated ``/metrics`` for N runs
+(``docs/observability.md`` "Pod telemetry hub").
+
+Every run already publishes its own OpenMetrics exposition (per-rank
+textfiles and/or a rank-0 HTTP endpoint — ``obs/export.py``) and its
+heartbeat; the fleet scheduler used to walk those N textfiles itself.
+That fan-out does not scale past a handful of runs and gives a pod
+operator no single place to point a scraper. This module is the
+controller-side fix:
+
+* :func:`sample_run` — the ONE scrape primitive: one run's exposition
+  (textfile preferred, HTTP fallback) plus its heartbeat verdict, as a
+  plain dict. ``fleet/scheduler.py::read_signals`` consumes THIS — the
+  scheduler no longer opens metrics files itself (the regression pin in
+  ``tests/test_hub.py`` keeps it that way).
+* :class:`TelemetryHub` — the pull-aggregator: scrape every registered
+  :class:`RunSource`, tolerate the real-world failure modes **with
+  counted drops** (a torn mid-rename exposition serves the last good
+  parse and counts ``torn``; a stale/absent heartbeat marks the run
+  **dead with its last-seen age** — never silently dropped; a run that
+  has not published yet counts ``absent``), and render ONE federated
+  exposition: every sample re-labeled ``{run="<name>"}``, hub health
+  gauges, and the pod rollups (total/free/pending chips from the
+  capacity ledger's own exposition, per-class goodput, worst-run stall,
+  breach count, the last arbitration ``decision_id``).
+* ``python -m tpu_dist.obs hub`` — the CLI: one-shot or looped
+  aggregation to a textfile and/or an HTTP ``/metrics`` endpoint
+  (the same snapshot-under-lock discipline as ``MetricsExporter``).
+
+Cost contract: the hub is pure host-side string/file work — jaxpr rule
+**TD123** proves the traced train AND serve steps are byte-identical
+with the hub armed and scraped mid-audit (vacuity-guarded: a hub that
+aggregated zero runs is itself a violation).
+
+Stdlib-only on purpose: the hub runs on the pod's controller VM where
+no jax exists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tpu_dist.obs import export as export_lib
+from tpu_dist.obs import heartbeat as heartbeat_lib
+
+#: Heartbeat older than this reads as a dead/wedged run — ONE home for
+#: the threshold (``fleet/scheduler.py`` and ``obs tail`` import it).
+STALE_AFTER_S = 60.0
+
+#: The run classes the rollups aggregate by (mirrors RunSpec.kind).
+RUN_KINDS = ("train", "serve")
+
+
+@dataclasses.dataclass(frozen=True)
+class RunSource:
+    """One run the hub scrapes: its exposition (textfile and/or rank-0
+    HTTP port — textfile preferred, HTTP the fallback when the file is
+    unreadable), its heartbeat file, and its class (``kind`` buckets the
+    per-class goodput rollup)."""
+
+    run: str
+    metrics_file: Optional[str] = None
+    port: Optional[int] = None
+    heartbeat_file: Optional[str] = None
+    kind: str = "train"
+
+    def __post_init__(self):
+        if not self.run:
+            raise ValueError("a RunSource needs a run name")
+        if self.metrics_file is None and self.port is None:
+            raise ValueError(f"{self.run}: need a metrics_file or a port")
+        if self.kind not in RUN_KINDS:
+            raise ValueError(f"{self.run}: kind {self.kind!r} not in {RUN_KINDS}")
+
+
+def sample_run(
+    run: str,
+    *,
+    metrics_file: Optional[str] = None,
+    port: Optional[int] = None,
+    heartbeat_file: Optional[str] = None,
+    now: Optional[float] = None,
+    stale_after_s: float = STALE_AFTER_S,
+) -> dict:
+    """Scrape ONE run: its latest exposition plus its heartbeat verdict.
+
+    Pure file/socket reads, never raises — an absent or unreadable
+    exposition degrades to empty ``values``. Returns::
+
+        {"run", "values": {name_or_name{labels}: float},
+         "scraped": bool, "source": "textfile"|"http"|None,
+         "alive": True|False|None, "heartbeat_age_s": float|None}
+
+    ``alive`` is None when no heartbeat source was configured (liveness
+    unknowable), False on an absent/stale/garbage beat — the same
+    fail-closed verdicts ``read_signals`` always gave.
+    """
+    values: Dict[str, float] = {}
+    source: Optional[str] = None
+    if metrics_file is not None:
+        got = export_lib.scrape(textfile=metrics_file)
+        if got is not None:
+            values, source = got, "textfile"
+    if source is None and port is not None:
+        got = export_lib.scrape(port=port)
+        if got is not None:
+            values, source = got, "http"
+    age: Optional[float] = None
+    alive: Optional[bool] = None
+    if heartbeat_file is not None:
+        rec = heartbeat_lib.read(heartbeat_file)
+        if rec is None:
+            alive = False  # absent beat on a run we were told beats
+        else:
+            ts = rec.get("ts")
+            if isinstance(ts, (int, float)) and not isinstance(ts, bool):
+                age = (time.time() if now is None else now) - float(ts)
+                alive = age <= stale_after_s
+            else:
+                # a beat that parsed but carries no usable timestamp is
+                # as dead as a stale one — fail closed, never None
+                alive = False
+    return {
+        "run": run,
+        "values": values,
+        "scraped": source is not None,
+        "source": source,
+        "alive": alive,
+        "heartbeat_age_s": round(age, 1) if age is not None else None,
+    }
+
+
+def _gauge(values: Dict[str, float], raw: str) -> Optional[float]:
+    return values.get(export_lib.metric_name(raw))
+
+
+class TelemetryHub:
+    """Pull-aggregate N :class:`RunSource` expositions into one.
+
+    ``fleet_exposition`` (optional) is the path the fleet scheduler's
+    :meth:`~tpu_dist.fleet.scheduler.FleetScheduler.write_exposition`
+    publishes — the capacity ledger the chip rollups come from
+    (total/free/pending chips, decision/preemption counters, the last
+    ``decision_id``). Without it the chip rollups are simply absent.
+
+    Drop accounting is cumulative across :meth:`collect` calls (the
+    hub's own ``hub.drops_total{reason=...}`` family) AND per-snapshot
+    (``snapshot["drops"]``): a torn exposition, a dead run, an absent
+    one — every degraded scrape is counted, never silent.
+    """
+
+    def __init__(
+        self,
+        sources: List[RunSource],
+        *,
+        fleet_exposition: Optional[str] = None,
+        stale_after_s: float = STALE_AFTER_S,
+    ):
+        if not sources:
+            raise ValueError("a hub needs at least one RunSource")
+        names = [s.run for s in sources]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate run names: {names}")
+        self.sources = list(sources)
+        self.fleet_exposition = fleet_exposition
+        self.stale_after_s = stale_after_s
+        self.scrapes = 0
+        self.drops_total = {"torn": 0, "dead": 0, "absent": 0}
+        # last-good cache per run (the heartbeat _LAST_GOOD discipline):
+        # a torn mid-rename exposition must serve the previous parse,
+        # not a hole — and be COUNTED doing it
+        self._last_good: Dict[str, Dict[str, float]] = {}
+
+    # -- scraping ------------------------------------------------------------
+
+    def _scrape_one(self, src: RunSource, now: Optional[float]) -> dict:
+        """One run's hub view: :func:`sample_run` hardened with torn
+        detection (an exposition that does not end in ``# EOF`` was
+        caught mid-write by a non-atomic publisher — serve the last
+        good parse) and the dead/absent classification."""
+        torn = False
+        if src.metrics_file is not None:
+            try:
+                with open(src.metrics_file) as f:
+                    text = f.read()
+            except OSError:
+                text = None
+            if text is not None and not text.rstrip().endswith("# EOF"):
+                torn = True
+        sample = sample_run(
+            src.run,
+            metrics_file=src.metrics_file,
+            port=src.port,
+            heartbeat_file=src.heartbeat_file,
+            now=now,
+            stale_after_s=self.stale_after_s,
+        )
+        sample["kind"] = src.kind
+        if torn and sample["source"] == "textfile":
+            # mid-rename tear: whatever parsed is suspect — fall back
+            sample["values"] = dict(self._last_good.get(src.run, {}))
+            sample["torn"] = True
+        else:
+            sample["torn"] = False
+            if sample["values"]:
+                self._last_good[src.run] = dict(sample["values"])
+        sample["dead"] = sample["alive"] is False
+        sample["absent"] = not sample["values"] and not sample["torn"]
+        return sample
+
+    def collect(self, now: Optional[float] = None) -> dict:
+        """One aggregation pass: every source scraped, drops counted,
+        rollups computed. Returns the snapshot dict :meth:`federated`
+        renders (``runs`` keeps EVERY registered run — a dead run is
+        marked dead with its last-seen age, never removed)."""
+        self.scrapes += 1
+        runs: Dict[str, dict] = {}
+        drops = {"torn": 0, "dead": 0, "absent": 0}
+        for src in self.sources:
+            sample = self._scrape_one(src, now)
+            runs[src.run] = sample
+            for reason in drops:
+                if sample.get(reason):
+                    drops[reason] += 1
+                    self.drops_total[reason] += 1
+        fleet: Dict[str, float] = {}
+        if self.fleet_exposition:
+            fleet = export_lib.scrape(textfile=self.fleet_exposition) or {}
+        return {
+            "runs": runs,
+            "drops": drops,
+            "drops_total": dict(self.drops_total),
+            "fleet": fleet,
+            "rollup": self._rollup(runs, fleet),
+            "scrapes": self.scrapes,
+        }
+
+    def _rollup(self, runs: Dict[str, dict], fleet: Dict[str, float]) -> dict:
+        """The pod-level gauges: chips from the capacity ledger's own
+        exposition, per-class goodput means, the worst stall, and how
+        many serve runs currently fire an ``slo_*`` alert."""
+        out: dict = {
+            "runs_aggregated": sum(1 for s in runs.values() if s["values"]),
+            "runs_dead": sum(1 for s in runs.values() if s["dead"]),
+        }
+        for raw, name in (
+            ("fleet.total_chips", "total_chips"),
+            ("fleet.free_chips", "free_chips"),
+            ("fleet.pending_chips", "pending_chips"),
+            ("fleet.decisions", "decisions"),
+            ("fleet.preemptions", "preemptions"),
+            ("fleet.last_decision_id", "last_decision_id"),
+        ):
+            v = _gauge(fleet, raw)
+            if v is not None:
+                out[name] = v
+        goodput: Dict[str, List[float]] = {}
+        worst_stall: Optional[Tuple[float, str]] = None
+        breaches = 0
+        for name, s in runs.items():
+            vals = s["values"]
+            g = _gauge(vals, "goodput.goodput_frac")
+            if g is not None:
+                goodput.setdefault(s["kind"], []).append(g)
+            stall = _gauge(vals, "train.data_stall_frac")
+            if stall is not None and (
+                worst_stall is None or stall > worst_stall[0]
+            ):
+                worst_stall = (stall, name)
+            if any(
+                a.startswith("slo_")
+                for a in export_lib.active_labels(vals)
+            ):
+                breaches += 1
+        out["goodput_by_kind"] = {
+            kind: round(sum(v) / len(v), 4) for kind, v in sorted(goodput.items())
+        }
+        if worst_stall is not None:
+            out["worst_stall_frac"] = worst_stall[0]
+            out["worst_stall_run"] = worst_stall[1]
+        out["breach_count"] = breaches
+        return out
+
+    # -- federation ----------------------------------------------------------
+
+    @staticmethod
+    def _labeled(name: str, run: str) -> str:
+        """Inject the ``run`` label into a scraped sample name —
+        ``tpu_dist_x`` → ``tpu_dist_x{run="r"}``, and an already-labeled
+        ``tpu_dist_alert_active{rule="y"}`` keeps its label:
+        ``tpu_dist_alert_active{rule="y",run="r"}``."""
+        safe = run.replace("\\", "\\\\").replace('"', '\\"')
+        if name.endswith("}") and "{" in name:
+            return f'{name[:-1]},run="{safe}"}}'
+        return f'{name}{{run="{safe}"}}'
+
+    def federated(self, snapshot: Optional[dict] = None) -> str:
+        """Render one snapshot as THE pod exposition: every run's
+        samples re-labeled ``{run=...}``, the hub's own health/drop
+        gauges, and the ``pod.*`` rollups. Ends with ``# EOF``."""
+        snap = snapshot if snapshot is not None else self.collect()
+        lines: List[str] = []
+        rollup = snap["rollup"]
+        pod_values = {
+            "pod.runs_aggregated": rollup.get("runs_aggregated", 0),
+            "pod.runs_dead": rollup.get("runs_dead", 0),
+            "pod.breach_count": rollup.get("breach_count", 0),
+            "hub.scrapes_total": snap.get("scrapes", self.scrapes),
+        }
+        for name in (
+            "total_chips", "free_chips", "pending_chips",
+            "decisions", "preemptions", "last_decision_id",
+            "worst_stall_frac",
+        ):
+            if rollup.get(name) is not None:
+                pod_values[f"pod.{name}"] = rollup[name]
+        for raw in sorted(pod_values):
+            name = export_lib.metric_name(raw)
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {export_lib._fmt_value(pod_values[raw])}")
+        drops_name = export_lib.metric_name("hub.drops_total")
+        lines.append(f"# TYPE {drops_name} gauge")
+        for reason in sorted(snap["drops_total"]):
+            lines.append(
+                f'{drops_name}{{reason="{reason}"}} '
+                f'{export_lib._fmt_value(snap["drops_total"][reason])}'
+            )
+        gpk = rollup.get("goodput_by_kind") or {}
+        if gpk:
+            name = export_lib.metric_name("pod.goodput_frac")
+            lines.append(f"# TYPE {name} gauge")
+            for kind in sorted(gpk):
+                lines.append(
+                    f'{name}{{kind="{kind}"}} {export_lib._fmt_value(gpk[kind])}'
+                )
+        up_name = export_lib.metric_name("hub.run_up")
+        age_name = export_lib.metric_name("hub.run_heartbeat_age_s")
+        lines.append(f"# TYPE {up_name} gauge")
+        for run in sorted(snap["runs"]):
+            s = snap["runs"][run]
+            up = 0 if s["dead"] else 1
+            lines.append(f'{self._labeled(up_name, run)} {up}')
+        if any(
+            s["heartbeat_age_s"] is not None for s in snap["runs"].values()
+        ):
+            lines.append(f"# TYPE {age_name} gauge")
+        for run in sorted(snap["runs"]):
+            s = snap["runs"][run]
+            if s["heartbeat_age_s"] is not None:
+                lines.append(
+                    f'{self._labeled(age_name, run)} '
+                    f'{export_lib._fmt_value(s["heartbeat_age_s"])}'
+                )
+        for run in sorted(snap["runs"]):
+            for name in sorted(snap["runs"][run]["values"]):
+                v = snap["runs"][run]["values"][name]
+                lines.append(
+                    f"{self._labeled(name, run)} {export_lib._fmt_value(v)}"
+                )
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+    def write(self, path: str, snapshot: Optional[dict] = None) -> None:
+        """Atomically publish the federated exposition (tmp +
+        ``os.replace`` — a scraper never sees a torn hub)."""
+        text = self.federated(snapshot)
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        # tpu-dist: ignore[TD002,TD007] — the hub is a single controller
+        # process by construction; there is exactly one writer per path
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+
+
+class HubServer:
+    """The hub's HTTP half: serve the LAST PUBLISHED federated snapshot
+    at ``GET /metrics`` (bytes under a lock — the handler thread never
+    scrapes, so a slow source can never stall a scrape of the hub
+    itself; the ``MetricsExporter`` snapshot discipline)."""
+
+    def __init__(self, port: int):
+        from http.server import ThreadingHTTPServer
+
+        self._lock = threading.Lock()
+        self._body = b"# EOF\n"
+        srv = ThreadingHTTPServer(("", port), export_lib._Handler)
+        srv.daemon_threads = True
+        srv.exporter_body = self._snapshot  # type: ignore[attr-defined]
+        self._server = srv
+        self.port = srv.server_address[1]  # resolves port=0 requests
+        self._thread = threading.Thread(
+            target=srv.serve_forever, name="telemetry-hub", daemon=True
+        )
+        self._thread.start()
+
+    def _snapshot(self) -> bytes:
+        with self._lock:
+            return self._body
+
+    def publish(self, text: str) -> None:
+        with self._lock:
+            self._body = text.encode()
+
+    def close(self) -> None:
+        if self._server is not None:
+            srv, self._server = self._server, None
+            srv.shutdown()
+            srv.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "HubServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def parse_source(spec: str) -> RunSource:
+    """CLI grammar for one ``--run``: ``name=metrics_path`` with optional
+    ``,hb=<heartbeat>`` / ``,port=<p>`` / ``,kind=<train|serve>`` parts,
+    e.g. ``svc=/pod/svc/metrics.prom,hb=/pod/svc/hb.json,kind=serve``.
+    A bare ``name=port:9100`` registers an HTTP-only source."""
+    if "=" not in spec:
+        raise ValueError(f"--run {spec!r}: want name=metrics_path[,...]")
+    run, rest = spec.split("=", 1)
+    parts = rest.split(",")
+    kw: dict = {"run": run}
+    head = parts[0]
+    if head.startswith("port:"):
+        kw["port"] = int(head[len("port:"):])
+    elif head:
+        kw["metrics_file"] = head
+    for part in parts[1:]:
+        if "=" not in part:
+            raise ValueError(f"--run {spec!r}: bad part {part!r}")
+        k, v = part.split("=", 1)
+        if k == "hb":
+            kw["heartbeat_file"] = v
+        elif k == "port":
+            kw["port"] = int(v)
+        elif k == "kind":
+            kw["kind"] = v
+        else:
+            raise ValueError(f"--run {spec!r}: unknown key {k!r}")
+    return RunSource(**kw)

@@ -52,13 +52,13 @@ from tpu_dist.obs import summarize as summ
 BUNDLE_NAME = "postmortem.json"
 
 #: ``postmortem`` records stamp the CURRENT history schema (metrics/
-#: history.py — v9 introduced this kind; v14 is current after the
-#: additive ``tenancy`` kind). Kept as a literal so this module stays
-#: jax-free (the watchdog's auto-invoke and any laptop holding the
-#: copied files must not need a backend); pinned to the real
+#: history.py — v9 introduced this kind; v15 is current after the
+#: additive causal-tracing fields). Kept as a literal so this module
+#: stays jax-free (the watchdog's auto-invoke and any laptop holding
+#: the copied files must not need a backend); pinned to the real
 #: SCHEMA_VERSION by ``tests/test_flight.py`` — the fleet-module
 #: discipline (``FLEET_SCHEMA_VERSION``).
-POSTMORTEM_SCHEMA_VERSION = 14
+POSTMORTEM_SCHEMA_VERSION = 15
 
 #: Artifact stems recognized during discovery; each may carry the
 #: ``.h<k>`` per-rank suffix. History files are any ``*.jsonl``.

@@ -30,7 +30,7 @@ from tpu_dist.obs import memory as memory_lib
 #: kinds summarized; their unknown kinds are skipped with a count — the
 #: forward-compat contract that lets v3 tooling read v4 logs and vice
 #: versa (every schema bump is additive).
-SUPPORTED_SCHEMA = 14
+SUPPORTED_SCHEMA = 15
 
 #: Record kinds this reader folds into the report. Anything else is
 #: counted into ``skipped_kinds`` — never an error, never silent.
@@ -167,7 +167,8 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
                 k: rec.get(k)
                 for k in ("epoch", "world", "dp", "prev_dp", "prev_procs",
                           "resharded", "restarts", "mid_epoch_step",
-                          "examples_offset")
+                          "examples_offset", "decision_id",
+                          "decision_cause")
                 if rec.get(k) is not None
             })
             # the FIRST segment logs no resume record (fresh starts
@@ -191,7 +192,8 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
                 k: rec.get(k)
                 for k in ("tick", "action", "donor", "recipient", "for_run",
                           "chips", "alloc_before", "alloc_after",
-                          "pending_after", "reason", "inputs")
+                          "pending_after", "reason", "inputs",
+                          "decision_id", "cause", "chained", "preempt")
                 if rec.get(k) is not None
             })
         elif kind == "tenancy":
@@ -202,7 +204,7 @@ def summarize(records: List[dict], bad_lines: int = 0) -> dict:
             tenancy_snapshots.append({
                 k: rec.get(k)
                 for k in ("tick", "alloc", "free", "pending",
-                          "total_chips", "run_kinds")
+                          "total_chips", "run_kinds", "decision_id")
                 if rec.get(k) is not None
             })
         elif kind == "postmortem":
@@ -500,6 +502,15 @@ def format_text(report: dict) -> str:
             + (
                 f" — elastic restart #{rs['restarts']}"
                 if rs.get("restarts") else ""
+            )
+            + (
+                # causal tracing (schema v15): a fleet-initiated resize
+                # names its arbitration; a chip-loss one carries none
+                f" [decision #{rs['decision_id']}"
+                + (f": {rs['decision_cause']}" if rs.get("decision_cause")
+                   else "")
+                + "]"
+                if rs.get("decision_id") is not None else ""
             )
         )
     for fd in report.get("fleet_decisions", []):

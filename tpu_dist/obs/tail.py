@@ -214,6 +214,13 @@ class TailState:
                         f", restart #{rec.get('restarts')}"
                         if rec.get("restarts") else ""
                     )
+                    + (
+                        # causal tracing (schema v15): a resume actuating
+                        # a fleet decision names it — live view shows the
+                        # same chain the pod report renders offline
+                        f" [decision #{rec.get('decision_id')}]"
+                        if rec.get("decision_id") is not None else ""
+                    )
                 )
             elif kind == "fleet":
                 # a scheduler decision (schema v8): chips moved between

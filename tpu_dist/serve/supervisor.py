@@ -95,11 +95,17 @@ class ReplicaSupervisor:
         now: Callable[[], float] = time.time,
         sleep: Callable[[float], None] = time.sleep,
         on_event: Optional[Callable[[dict], None]] = None,
+        capacity_file: Optional[str] = None,
     ):
         self._spawn = spawn
         self.heartbeat_file = heartbeat_file
         self.policy = policy or ReplicaPolicy()
         self.postmortem_dirs = list(postmortem_dirs or [])
+        # the replica's allocation file (fleet multi-tenancy): when the
+        # fleet arbiter granted/grew this run, the file carries the
+        # decision metadata tokens — every spawn names the arbitration
+        # that shaped its capacity (schema v15 causal tracing)
+        self.capacity_file = capacity_file
         self._now = now
         self._sleep = sleep
         self._on_event = on_event
@@ -140,7 +146,18 @@ class ReplicaSupervisor:
         self._spawned_at = self._now()
         self._beat_seen = False
         counters_lib.inc("serve.replica_spawns")
-        self._event("spawn", pid=getattr(self.proc, "pid", None))
+        ev: dict = {"pid": getattr(self.proc, "pid", None)}
+        if self.capacity_file:
+            # recipient-side causal tracing: the grant/grow that sized
+            # this replica rides the allocation file's metadata tokens —
+            # stamp it so the event stream joins the scheduler's chain
+            from tpu_dist.elastic.supervisor import read_decision
+
+            meta = read_decision(self.capacity_file)
+            if meta.get("decision_id") is not None:
+                ev["decision_id"] = meta["decision_id"]
+                ev["decision_cause"] = meta.get("cause")
+        self._event("spawn", **ev)
 
     def _bundle(self, verdict_hint: str) -> Optional[str]:
         """Postmortem-bundle the evidence dirs through the existing

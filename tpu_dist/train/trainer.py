@@ -2706,11 +2706,34 @@ class Trainer:
             _restarts = 0
         if _restarts:
             counters_lib.set_gauge("elastic.restarts", _restarts)
+        # causal arbitration tracing (schema v15): a relaunch that
+        # actuates a fleet decision carries the scheduler's id/cause in
+        # env (launcher reads them off the allocation file) — stamped
+        # into the resume record, the flight-ring slot, and the
+        # fleet.decision_id gauge, so every artifact layer names WHICH
+        # arbitration moved this run (a chip-loss relaunch has none)
+        _decision_id: "Optional[int]" = None
+        _decision_cause = _os.environ.get("TPU_DIST_FLEET_DECISION_CAUSE") or None
+        try:
+            _raw_did = _os.environ.get("TPU_DIST_FLEET_DECISION_ID", "")
+            _decision_id = int(_raw_did) if _raw_did else None
+        except ValueError:
+            _decision_id = None
+        if _decision_id is not None:
+            counters_lib.set_gauge("fleet.decision_id", _decision_id)
         if self._elastic_resume is not None:
             # one 'resume' record per resumed segment (schema v7): world
             # size, reshard flag, re-entry position — the segment-boundary
             # line obs summarize/tail/pod render
-            history.log("resume", restarts=_restarts, **self._elastic_resume)
+            _trace = {}
+            if _decision_id is not None:
+                _trace["decision_id"] = _decision_id
+                if _decision_cause:
+                    _trace["decision_cause"] = _decision_cause
+            history.log(
+                "resume", restarts=_restarts, **_trace,
+                **self._elastic_resume,
+            )
             if self._flight is not None:
                 self._flight.record(
                     "resume",
@@ -2718,6 +2741,7 @@ class Trainer:
                     world=self._elastic_resume.get("world"),
                     dp=self._elastic_resume.get("dp"),
                     resharded=self._elastic_resume.get("resharded"),
+                    **_trace,
                 )
             self._elastic_resume = None
         # re-arm host-span tracing (construction armed it before the
