@@ -1,6 +1,6 @@
 # Convenience targets; CI / the driver call the underlying commands directly.
 
-.PHONY: test quick bench csrc clean lint shard-report plan-report tune-overlap ckpt-bench pod-report monitor profile-report elastic-drill fleet-drill postmortem-drill serve-drill tenancy-drill hub-drill serve-report memory-report
+.PHONY: test quick bench csrc clean lint shard-report plan-report tune-overlap ckpt-bench pod-report monitor profile-report elastic-drill fleet-drill postmortem-drill serve-drill tenancy-drill hub-drill serve-report memory-report trend-report
 
 csrc:
 	$(MAKE) -C tpu_dist/csrc
@@ -153,6 +153,22 @@ serve-report:
 # reconciliation, OOM events, and the peak-HBM compare-gate scalar)
 memory-report:
 	python -m tpu_dist.obs memory $(LOG)
+
+# The longitudinal-archive proof (docs/observability.md "Longitudinal
+# archive & trend gating"): rebuild the trend archive from the repo's
+# committed bench/multichip artifacts (must match the seeded
+# tools/bench_archive.jsonl record-for-record), gate the last-good
+# capture against its own rolling MAD band (exit 0 — a sane history
+# admits itself), render the trend + changepoint blame report, and run
+# the TD124 inject-regression self-test: a just-outside-band injection
+# must be CAUGHT per band, an improvement must pass, and the synthetic
+# changepoint must be localized — a dead detector exits 2:
+#   make trend-report [OUT=/tmp/trend_archive.jsonl]
+trend-report:
+	python -m tpu_dist.obs archive ingest BENCH_r01.json BENCH_r02.json BENCH_r03.json BENCH_r04.json BENCH_r05.json MULTICHIP_r01.json MULTICHIP_r02.json MULTICHIP_r03.json MULTICHIP_r04.json MULTICHIP_r05.json LAST_GOOD_BENCH.json --archive $(or $(OUT),/tmp/trend_archive.jsonl)
+	python -m tpu_dist.obs compare --against-archive $(or $(OUT),/tmp/trend_archive.jsonl) --bench LAST_GOOD_BENCH.json
+	python -m tpu_dist.obs trend $(or $(OUT),/tmp/trend_archive.jsonl) --blame
+	python -m tpu_dist.obs trend $(or $(OUT),/tmp/trend_archive.jsonl) --inject-regression
 
 # Follow a LIVE run from another terminal:
 #   make monitor LOG=run.jsonl [HB=hb.json]

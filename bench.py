@@ -50,10 +50,45 @@ def _capture_fingerprint() -> dict:
 
 _CAPTURE = _capture_fingerprint()
 
+#: Every record this process emitted (``_stamped`` appends) — the
+#: ``--archive`` self-ingest reads this at exit so the longitudinal
+#: archive (``tpu_dist/obs/archive.py``) stays current without a
+#: separate ingest step.
+_EMITTED: list = []
+
 
 def _stamped(rec: dict) -> dict:
     rec["capture"] = {**_CAPTURE, "mono_s": round(time.monotonic(), 3)}
+    _EMITTED.append(rec)
     return rec
+
+
+def _self_ingest(path: str, records=None) -> None:
+    """Fold this invocation's records into the longitudinal archive.
+    NEVER dies: a broken archive must not fail the bench that measured
+    fine — the failure is counted to stderr instead (the archive's own
+    loader counts torn/foreign lines the same way)."""
+    import sys  # noqa: PLC0415
+
+    recs = _EMITTED if records is None else records
+    if not recs:
+        return
+    try:
+        from tpu_dist.obs import archive as archive_lib  # noqa: PLC0415
+
+        rep = archive_lib.ingest_records(recs, path, source_path="bench.py")
+        print(
+            f"bench: archived {rep['appended']} record(s) to {path}"
+            + (f" ({rep['deduped']} already present)"
+               if rep["deduped"] else ""),
+            file=sys.stderr, flush=True,
+        )
+    except Exception as e:  # the never-dies contract: count, don't raise
+        print(
+            f"bench: archive self-ingest FAILED ({len(recs)} record(s) "
+            f"NOT archived): {type(e).__name__}: {e}",
+            file=sys.stderr, flush=True,
+        )
 
 
 def _costmodel():
@@ -854,7 +889,10 @@ def run_ckpt(cfg: BenchConfig, warmup: int, mode: str, saves: int = 6) -> dict:
     return _stamped(out)
 
 
-def _guarded_backend_init(timeout_s: float, default_invocation: bool = False) -> None:
+def _guarded_backend_init(
+    timeout_s: float, default_invocation: bool = False,
+    archive: "str | None" = None,
+) -> None:
     """Fail loudly (exit 3) if device discovery hangs — a wedged TPU tunnel
     must not hang the calling harness forever.
 
@@ -918,6 +956,11 @@ def _guarded_backend_init(timeout_s: float, default_invocation: bool = False) ->
         print(line, flush=True)
         print("bench: emitted stale last-good capture: " + line,
               file=sys.stderr, flush=True)
+        if archive:
+            # the stale fallback exits via os._exit (atexit never runs),
+            # so the self-ingest happens here — the archive records the
+            # re-emission FLAGGED stale, exactly the r03–r05 trajectory
+            _self_ingest(archive, [last])
         os._exit(0)
     except (OSError, ValueError) as e:
         print(f"bench: no last-good capture available ({e})",
@@ -1115,7 +1158,20 @@ def main() -> None:
              "scaling efficiency (BASELINE's 1→8→32 chip metric; limited "
              "by visible devices)",
     )
+    p.add_argument(
+        "--archive", default=None, metavar="PATH",
+        help="self-ingest every emitted record into this longitudinal "
+             "archive at exit (python -m tpu_dist.obs archive / trend; "
+             "never-dies — an archive failure is counted to stderr, "
+             "not fatal to the bench)",
+    )
     args = p.parse_args()
+    if args.archive:
+        import atexit
+
+        # normal exits (and sys.exit) archive whatever _stamped emitted;
+        # the os._exit stale-fallback path self-ingests inline instead
+        atexit.register(_self_ingest, args.archive)
     if args.batch_size:
         import dataclasses
 
@@ -1142,6 +1198,7 @@ def main() -> None:
 
     _guarded_backend_init(
         args.init_timeout,
+        archive=args.archive,
         default_invocation=(
             args.config == "resnet18_cifar100"
             and args.grad_compression == "none"

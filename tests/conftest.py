@@ -76,7 +76,7 @@ _QUICK = (
     "test_launch_and_history.py", "test_fused_sgd.py", "test_observability.py",
     "test_obs.py", "test_device_health.py", "test_goodput.py",
     "test_export.py", "test_xprof.py", "test_flight.py", "test_serve.py",
-    "test_memory.py", "test_tenancy.py", "test_hub.py",
+    "test_memory.py", "test_tenancy.py", "test_hub.py", "test_archive.py",
     "test_models.py::test_param_count_parity[resnet18",
     "test_models.py::test_eval_uses_running_stats",
     "test_vit.py::test_vit_forward_shape",

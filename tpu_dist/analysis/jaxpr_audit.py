@@ -1901,6 +1901,122 @@ def pod_hub_noop_violations(mesh=None) -> list[Violation]:
     return out
 
 
+def archive_gate_noop_violations(mesh=None) -> list[Violation]:
+    """TD124: the longitudinal-archive cost AND vacuity contract — trace
+    the data-parallel train step bare, then arm the FULL archive kit
+    exactly as CI runs it: ingest a synthetic bench history (fresh
+    captures plus one stale re-emission) into a tempdir archive twice
+    (the second pass must append NOTHING — idempotence by fingerprint),
+    require the stale copy flagged and excluded from the band, run the
+    ``--inject-regression`` probe (a past-band candidate must come back
+    REGRESSED, an improvement clean, an injected changepoint localized
+    by blame to the exact record), and trace the step again mid-audit.
+    The jaxpr must be byte-identical — the archive is host-side file
+    arithmetic, and the moment someone routes ingest or a band check
+    through a compiled step, this trips. A probe that misses any leg is
+    itself a violation: a dead detector silently passes every real
+    regression, which is the exact wound (BENCH_r03–r05 re-emissions
+    read as fresh) this subsystem exists to close."""
+    import json as json_lib
+    import os
+    import tempfile
+
+    import jax
+
+    from tpu_dist.comm import mesh as mesh_lib
+    from tpu_dist.obs import archive as archive_lib
+
+    m = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+    fn, args = _dp_setup(m)
+    base_train = str(jax.make_jaxpr(fn)(*args))
+
+    out: list[Violation] = []
+    path = "<jaxpr:archive_gate_noop>"
+    with tempfile.TemporaryDirectory(prefix="td124_") as td:
+        # -- arm: a synthetic bench history — 6 fresh captures around
+        # 100 img/s plus one stale-stamped re-emission of the last
+        bench_path = os.path.join(td, "bench.jsonl")
+        recs = []
+        for i in range(6):
+            recs.append({
+                "metric": "synthetic_train_throughput",
+                "value": 100.0 + [0.4, -0.3, 0.1, -0.2, 0.3, 0.0][i],
+                "unit": "images/sec",
+                "capture": {
+                    "host": "td124", "bench_run_id": f"run{i:02d}",
+                    "mono_s": float(i),
+                },
+            })
+        # the stale re-emission: bench's last-good fallback re-emits the
+        # newest capture with its stale stamp (the BENCH_r05 shape)
+        recs.append(dict(recs[-1], stale=True, note="re-emitted last good"))
+        with open(bench_path, "w") as f:
+            for r in recs:
+                f.write(json_lib.dumps(r) + "\n")
+        arch = os.path.join(td, "archive.jsonl")
+        rep1 = archive_lib.ingest_paths([bench_path], arch)
+        rep2 = archive_lib.ingest_paths([bench_path], arch)
+        records, _counts = archive_lib.load_archive(arch)
+        band = archive_lib.band_for(
+            records, "synthetic_train_throughput", "value",
+        )
+        probe = archive_lib.inject_probe(records)
+
+        # -- vacuity guard: every leg must have genuinely fired
+        ran = (
+            rep1["appended"] == 7
+            and rep1["stale_appended"] == 1
+            and rep2["appended"] == 0
+            and rep2["deduped"] == 7
+            and band is not None and band["n"] == 6
+            and probe["bands_probed"] >= 1
+            and not archive_lib.probe_is_dead(probe)
+        )
+        if not ran:
+            out.append(
+                Violation(
+                    "TD124",
+                    path,
+                    0,
+                    "the archive-gate probe is VACUOUS or the detector "
+                    "is dead: ingest appended "
+                    f"{rep1['appended']}/{rep1['stale_appended']}-stale "
+                    f"then {rep2['appended']} on re-ingest (want 7/1 "
+                    "then 0 — idempotence by fingerprint with the stale "
+                    f"re-emission flagged), band n="
+                    f"{band['n'] if band else None} (want 6, stale "
+                    "excluded), inject-regression probe gate="
+                    f"{probe['gate_probe']} improvements_clean="
+                    f"{probe['improvements_clean']} changepoint="
+                    f"{probe['changepoint_probe']} (want caught/True/"
+                    "localized) — a gate that cannot catch its own "
+                    "injected regression passes every real one "
+                    "(tpu_dist/obs/archive.py)",
+                    snippet="inject_probe(archive) came back dead",
+                )
+            )
+
+    armed_train = str(jax.make_jaxpr(fn)(*args))
+    if base_train != armed_train:
+        out.append(
+            Violation(
+                "TD124",
+                path,
+                0,
+                "the traced train step CHANGED when the longitudinal "
+                "archive kit was armed (ingest + MAD-band gate + "
+                "changepoint blame + injected-regression probe mid-"
+                "audit) — the archive must stay host-side file "
+                "arithmetic around the unmodified compiled step "
+                "(tpu_dist/obs/archive.py, docs/observability.md "
+                "'Longitudinal archive & trend gating')",
+                snippet="jaxpr(train, archive_off) != "
+                        "jaxpr(train, archive_armed)",
+            )
+        )
+    return out
+
+
 def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     """Run every (or the named) registered case. Returns
     ``(report, violations)`` where report maps case → op counts.
@@ -1910,8 +2026,8 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
     TD108 profiler-trigger, TD109 live-export/alerting, TD110
     capture-auto-analyze, TD111 elastic-resume, TD112 elastic-grow,
     TD113 flight-recorder, TD114 serving-SLO, TD115 memory-ledger,
-    TD122 tenancy-arbitration, and TD123 pod-telemetry-hub no-op
-    invariants."""
+    TD122 tenancy-arbitration, TD123 pod-telemetry-hub, and TD124
+    archive-gate no-op invariants."""
     report: dict = {}
     violations: list[Violation] = []
     for name in names if names is not None else registered_cases():
@@ -1958,6 +2074,9 @@ def audit_all(mesh=None, names=None) -> tuple[dict, list[Violation]]:
         violations.extend(vs)
         vs = pod_hub_noop_violations(mesh)
         report["pod_hub_noop"] = {"identical": not vs}
+        violations.extend(vs)
+        vs = archive_gate_noop_violations(mesh)
+        report["archive_gate_noop"] = {"identical": not vs}
         violations.extend(vs)
     return report, violations
 

@@ -318,6 +318,20 @@ RULES: dict[str, Rule] = {
             "docs/observability.md 'Pod telemetry hub')",
         ),
         Rule(
+            "TD124",
+            "archive-gate-not-vacuous",
+            "the longitudinal archive's regression machinery went dead or "
+            "device-side: an injected past-band candidate must come back "
+            "REGRESSED through the MAD-band gate, an injected improvement "
+            "must come back clean, an injected changepoint must be "
+            "localized by --blame to the exact archived record, ingest "
+            "must be idempotent by fingerprint with stale re-emissions "
+            "flagged and excluded from the band — and arming the full "
+            "ingest+gate+trend kit must leave the traced train step "
+            "byte-identical (tpu_dist/obs/archive.py, "
+            "docs/observability.md 'Longitudinal archive & trend gating')",
+        ),
+        Rule(
             "TD104",
             "quantized-wire-bytes-over-budget",
             "gradient-collective payload bytes of a quantized wire format "

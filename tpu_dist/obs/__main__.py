@@ -22,6 +22,8 @@ Subcommands::
 
     compare <baseline.jsonl> <candidate.jsonl> [--threshold 0.05]
             [--bench] [--goodput] [--slo] [--format text|json]
+    compare <candidate> --against-archive <archive.jsonl> [--bench]
+            [--band-k 3.0] [--band-window 20]
         Regression gate: diff throughput, step-time percentiles, stall
         fraction, MFU, goodput fraction, and final metrics between two
         runs' logs (or, with --bench, two bench.py JSON outputs).
@@ -29,7 +31,31 @@ Subcommands::
         (run-level goodput_frac + stall fraction); --slo to the serving
         SLO metrics (requests/s, latency p50/p99, TTFB, availability —
         lower latency is never flagged). Exits 1 on any regression
-        beyond the threshold — wire it into CI.
+        beyond the threshold — wire it into CI.  With
+        --against-archive the single input gates against the rolling
+        median ± k·MAD band of the archive's last N non-stale records
+        per metric (obs/archive.py) instead of one baseline — a stale
+        or all-stale band is exit 2, never a silent pass.
+
+    archive ingest <artifact> [<artifact> ...] --archive <archive.jsonl>
+        Longitudinal run archive (``obs/archive.py``): fold run
+        artifacts — bench JSONLs / LAST_GOOD_BENCH.json, the driver's
+        BENCH_*.json / MULTICHIP_*.json wrappers, --log_file histories,
+        shard/plan/tune reports — into one append-only archive of
+        schema-pinned archive_record_v1 lines. Idempotent by capture/
+        content fingerprint; stale re-emissions archive FLAGGED (the
+        PR 7 staleness discipline) and never join a band; torn tails
+        and newer schemas are counted, never fatal.
+
+    trend <archive.jsonl> [--metric NAME] [--window N] [--blame]
+          [--inject-regression] [--format text|json]
+        Per-metric series over the archive with an offline CUSUM
+        changepoint detector; --blame names the first archived record
+        where each shifted metric moved (fingerprint + run_id + source
+        — i.e. which PR's artifact moved it). --inject-regression runs
+        the TD124 probe: a synthetic past-band candidate must come
+        back REGRESSED, an improvement clean, and an injected step
+        localized to the exact record — a dead detector exits 2.
 
     hub --run name=metrics.prom[,hb=hb.json][,port=P][,kind=serve] ...
         [--fleet fleet.prom] [--out federated.prom] [--port P]
@@ -133,8 +159,13 @@ def main(argv=None) -> int:
         "compare",
         help="regression gate: diff two runs' telemetry, exit 1 on regression",
     )
-    c.add_argument("baseline", help="baseline --log_file JSONL (or bench JSON with --bench)")
-    c.add_argument("candidate", help="candidate --log_file JSONL (or bench JSON with --bench)")
+    c.add_argument("baseline", help="baseline --log_file JSONL (or bench "
+                                    "JSON with --bench); with "
+                                    "--against-archive this is the ONE "
+                                    "candidate input")
+    c.add_argument("candidate", nargs="?", default=None,
+                   help="candidate --log_file JSONL (or bench JSON with "
+                        "--bench); omitted with --against-archive")
     c.add_argument(
         "--threshold", type=float, default=0.05, metavar="FRAC",
         help="relative regression tolerance (default 0.05 = 5%%); each "
@@ -160,7 +191,60 @@ def main(argv=None) -> int:
              "metric registry, so a lower-latency candidate is never "
              "flagged; two serve-less logs compare nothing → exit 2",
     )
+    c.add_argument(
+        "--against-archive", default=None, metavar="ARCHIVE",
+        dest="against_archive",
+        help="gate the single candidate input against this longitudinal "
+             "archive's rolling median ± k·MAD bands (last N non-stale "
+             "records per metric, obs/archive.py) instead of one "
+             "baseline; a candidate re-emitting an archived capture, or "
+             "a band left with only STALE records, never passes "
+             "silently (exit 2)",
+    )
+    c.add_argument("--band-k", type=float, default=None, metavar="K",
+                   help="band half-width in MADs (--against-archive; "
+                        "default 3.0)")
+    c.add_argument("--band-window", type=int, default=None, metavar="N",
+                   help="band over the last N non-stale records "
+                        "(--against-archive; default 20)")
     c.add_argument("--format", choices=("text", "json"), default="text")
+    ar = sub.add_parser(
+        "archive",
+        help="longitudinal run archive: fold bench/driver/history/report "
+             "artifacts into one append-only fingerprinted archive.jsonl",
+    )
+    ar.add_argument("action", choices=("ingest",),
+                    help="'ingest' folds the given artifacts in "
+                         "(idempotent by fingerprint)")
+    ar.add_argument("inputs", nargs="+",
+                    help="artifacts: bench JSONL / LAST_GOOD_BENCH.json, "
+                         "driver BENCH_*.json / MULTICHIP_*.json, "
+                         "--log_file histories, shard/plan/tune reports")
+    ar.add_argument("--archive", "-a", default="archive.jsonl",
+                    metavar="PATH", help="the archive JSONL to append to "
+                                         "(default archive.jsonl)")
+    ar.add_argument("--format", choices=("text", "json"), default="text")
+    tr = sub.add_parser(
+        "trend",
+        help="per-metric series over the archive + CUSUM changepoint "
+             "blame (--blame) + the TD124 --inject-regression probe",
+    )
+    tr.add_argument("archive", help="the archive JSONL (archive ingest)")
+    tr.add_argument("--metric", default=None,
+                    help="render only this metric's series")
+    tr.add_argument("--window", type=int, default=None, metavar="N",
+                    help="keep only the trailing N points per series")
+    tr.add_argument("--blame", action="store_true",
+                    help="name the first archived record after each "
+                         "detected shift (fingerprint + run_id + source)")
+    tr.add_argument(
+        "--inject-regression", action="store_true",
+        dest="inject_regression",
+        help="TD124 probe: injected past-band candidates must come back "
+             "caught, improvements clean, and an injected changepoint "
+             "localized to the exact record — a dead detector exits 2",
+    )
+    tr.add_argument("--format", choices=("text", "json"), default="text")
     hb = sub.add_parser(
         "hub",
         help="pod telemetry hub: federate every run's exposition into "
@@ -189,6 +273,11 @@ def main(argv=None) -> int:
     hb.add_argument("--stale-after", type=float, default=None, metavar="S",
                     help="heartbeat age beyond which a run reads dead "
                          "(default: hub.STALE_AFTER_S)")
+    hb.add_argument("--archive", default=None, metavar="PATH",
+                    help="append one pod-rollup archive_record_v1 per "
+                         "aggregation pass to this longitudinal archive "
+                         "(obs/archive.py) — fleet goodput / breach "
+                         "count / chip capacity trend like bench metrics")
     pd = sub.add_parser(
         "pod",
         help="merge per-host logs into one cross-host report / timeline",
@@ -415,6 +504,10 @@ def main(argv=None) -> int:
                       f"run(s) to {args.out}")
             else:
                 print(text, end="")
+            if args.archive:
+                from tpu_dist.obs import archive as archive_lib
+
+                archive_lib.append_hub_snapshot(args.archive, snap)
             return 0 if snap["rollup"]["runs_aggregated"] else 1
         server = hub_lib.HubServer(args.port) if args.port else None
         if server is not None:
@@ -429,6 +522,12 @@ def main(argv=None) -> int:
                     h.write(args.out, snap)
                 if server is not None:
                     server.publish(text)
+                if args.archive:
+                    from tpu_dist.obs import archive as archive_lib
+
+                    # one pod-rollup record per interval — the fleet's
+                    # goodput/breach/chip series grows while the hub runs
+                    archive_lib.append_hub_snapshot(args.archive, snap)
                 _time.sleep(args.interval)
         except KeyboardInterrupt:
             return 0
@@ -465,9 +564,122 @@ def main(argv=None) -> int:
             )
         return 0
 
+    if args.cmd == "archive":
+        from tpu_dist.obs import archive as archive_lib
+
+        try:
+            report = archive_lib.ingest_paths(args.inputs, args.archive)
+        except (OSError, ValueError) as e:
+            print(f"tpu_dist.obs: archive ingest failed: {e}",
+                  file=sys.stderr)
+            return 2
+        if args.format == "json":
+            print(json.dumps(report, indent=2))
+        else:
+            print(archive_lib.format_ingest_text(report))
+        if report["records_seen"] == 0:
+            print("tpu_dist.obs: the inputs held no archivable records",
+                  file=sys.stderr)
+            return 1
+        return 0
+
+    if args.cmd == "trend":
+        from tpu_dist.obs import archive as archive_lib
+
+        try:
+            records, _counts = archive_lib.load_archive(args.archive)
+        except OSError as e:
+            print(f"tpu_dist.obs: cannot read {args.archive}: {e}",
+                  file=sys.stderr)
+            return 2
+        if not records:
+            print(f"tpu_dist.obs: no archive records in {args.archive}",
+                  file=sys.stderr)
+            return 1
+        if args.inject_regression:
+            probe = archive_lib.inject_probe(records)
+            if args.format == "json":
+                print(json.dumps(probe, indent=2))
+            else:
+                print(archive_lib.format_probe_text(probe))
+            if archive_lib.probe_is_dead(probe):
+                # an injected regression that came back unflagged, a
+                # wrongly flagged improvement, or an injected
+                # changepoint --blame cannot localize: the detector is
+                # dead and every real pass through it is vacuous
+                print(
+                    "tpu_dist.obs: the injected-regression probe came "
+                    "back CLEAN — the archive gate / changepoint "
+                    "detector is dead (TD124)", file=sys.stderr,
+                )
+                return 2
+            return 0
+        report = archive_lib.trend_report(
+            records, metric=args.metric, window=args.window,
+        )
+        if args.format == "json":
+            print(json.dumps(report, indent=2))
+        else:
+            print(archive_lib.format_trend_text(report, blame=args.blame))
+        return 0
+
     if args.cmd == "compare":
         from tpu_dist.obs import compare as compare_lib
 
+        if args.against_archive:
+            from tpu_dist.obs import archive as archive_lib
+
+            if args.candidate is not None:
+                print(
+                    "tpu_dist.obs: --against-archive takes ONE candidate "
+                    "positional (the archive IS the baseline)",
+                    file=sys.stderr,
+                )
+                return 2
+            if args.goodput or args.slo:
+                print(
+                    "tpu_dist.obs: --goodput/--slo gate two history "
+                    "logs; the archive gate bands every registered "
+                    "metric", file=sys.stderr,
+                )
+                return 2
+            try:
+                result = archive_lib.gate_files(
+                    args.against_archive, args.baseline, bench=args.bench,
+                    **({"k": args.band_k} if args.band_k is not None
+                       else {}),
+                    **({"window": args.band_window}
+                       if args.band_window is not None else {}),
+                )
+            except (OSError, ValueError) as e:
+                print(f"tpu_dist.obs: archive gate failed: {e}",
+                      file=sys.stderr)
+                return 2
+            if args.format == "json":
+                print(json.dumps(result, indent=2))
+            else:
+                print(archive_lib.format_gate_text(result))
+            if result["compared"] == 0:
+                # all-stale bands or no overlap: the gate compared
+                # nothing and must not pass silently
+                print(
+                    "tpu_dist.obs: the archive band compared nothing"
+                    + (
+                        " — every relevant record is STALE"
+                        if result.get("stale") else ""
+                    ),
+                    file=sys.stderr,
+                )
+                return 2
+            return 1 if result["regressions"] else 0
+        if args.candidate is None:
+            print("tpu_dist.obs: compare needs a baseline and a "
+                  "candidate (or --against-archive)", file=sys.stderr)
+            return 2
+        if args.band_k is not None or args.band_window is not None:
+            print("tpu_dist.obs: --band-k/--band-window only apply with "
+                  "--against-archive", file=sys.stderr)
+            return 2
         try:
             result = compare_lib.compare_files(
                 args.baseline, args.candidate,
@@ -501,6 +713,11 @@ def main(argv=None) -> int:
 
     if args.cmd == "summarize":
         report = summ.summarize(records, bad)
+        # stamp the capture identity + source path into the report
+        # header: archive ingest dedupes history reports by exactly this
+        # fingerprint (bench records carry their own capture stamps;
+        # histories get a content-hash identity here)
+        summ.stamp_capture(report, args.log)
         if args.format == "json":
             print(json.dumps(report, indent=2))
         else:

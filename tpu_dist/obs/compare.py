@@ -126,6 +126,21 @@ METRIC_DIRECTIONS: dict = {
     "batch_occupancy": ("higher", 0.02),
     "serve_batch_occupancy": ("higher", 0.02),
     "serve_queue_depth_max": ("lower", 1.0),
+    # longitudinal-archive series (obs/archive.py). multichip_ok is the
+    # driver's MULTICHIP_* pass/fail as a 0/1 point — a dry run that
+    # stopped passing is a regression. The pod_* gauges are the hub
+    # rollups `obs hub --archive` snapshots per interval: dead runs /
+    # SLO breaches growing or chips shrinking regress; goodput means
+    # carry the history gate's absolute point slack; the stall slack
+    # matches data_stall_frac. Integer counters get a 0.5 slack so an
+    # exactly-equal count never flags on the band's relative floor.
+    "multichip_ok": ("higher", 0.0),
+    "pod_runs_dead": ("lower", 0.5),
+    "pod_breach_count": ("lower", 0.5),
+    "pod_total_chips": ("higher", 0.0),
+    "pod_worst_stall_frac": ("lower", 0.02),
+    "pod_goodput_frac_train": ("higher", 0.01),
+    "pod_goodput_frac_serve": ("higher", 0.01),
 }
 
 

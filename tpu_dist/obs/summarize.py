@@ -63,6 +63,39 @@ def load_records(path: str) -> Tuple[List[dict], int]:
     return records, bad
 
 
+def capture_stamp(path: str) -> dict:
+    """The history log's capture identity — the history-side analogue of
+    the bench capture fingerprint (PR 7): a content hash of the log
+    itself, so two ingests of the same physical log dedupe and a
+    re-emitted copy is recognizable as the SAME capture rather than a
+    fresh run. Content-based on purpose: re-summarizing the identical
+    log on another host must produce the identical fingerprint."""
+    import hashlib  # noqa: PLC0415
+    import os  # noqa: PLC0415
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 16), b""):
+            h.update(chunk)
+    return {
+        "fingerprint": h.hexdigest()[:16],
+        "source_log": os.path.abspath(path),
+    }
+
+
+def stamp_capture(report: dict, path: str) -> dict:
+    """Stamp :func:`capture_stamp` into a summarize report's header
+    (``obs summarize --format json`` does this; archive ingest reads
+    it back for dedupe). Returns the report for chaining."""
+    stamp = capture_stamp(path)
+    report["source_log"] = stamp["source_log"]
+    report["capture"] = {
+        "fingerprint": stamp["fingerprint"],
+        "run_id": report.get("run_id"),
+    }
+    return report
+
+
 def _tenancy_audit(snapshots: List[dict]) -> dict:
     """The exact chip-second conservation audit over the ``tenancy``
     snapshots (fleet/scheduler.py owns the arithmetic; imported lazily —
